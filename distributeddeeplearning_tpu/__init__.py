@@ -1,0 +1,21 @@
+"""TPU-native distributed deep-learning framework.
+
+A ground-up re-design of the capabilities of Microsoft's
+DistributedDeepLearning cookiecutter (surveyed in SURVEY.md) for Cloud TPU:
+
+- control-plane CLI (``ddlt``) that provisions cloud resources, prepares
+  ImageNet data, and submits benchmark / training jobs locally or to a TPU pod
+  (reference: invoke task tree, ``{{proj}}/tasks.py``);
+- data-parallel (and tensor/sequence-parallel) training built on
+  ``jax.sharding.Mesh`` + ``jit`` with XLA collectives over ICI/DCN
+  (reference: Horovod 0.15.2 over MPI/NCCL, ``control/src/aml_compute.py``);
+- ResNet / Inception / BERT model families, synthetic + real ImageNet input
+  pipelines, orbax checkpoint/resume, TensorBoard-style metrics, and the same
+  img/sec measurement methodology (BASELINE.md).
+
+No NCCL, MPI, or nvidia-docker anywhere in the loop.
+"""
+
+from distributeddeeplearning_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,59 @@
+"""``ddlt`` — the control-plane CLI.
+
+The TPU-native replacement for the reference's invoke task tree
+(``{{proj}}/tasks.py:180-225`` plus per-workload submit modules).  The same
+verb shape — ``setup``, ``submit.{local,remote}.{synthetic,images,tfrecords}``,
+``storage.*``, ``tensorboard``, ``runs`` — built on argparse subcommands
+(no third-party task runner).
+
+This module starts minimal and grows with the framework; every verb either
+works end-to-end or states clearly what is not yet wired.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from distributeddeeplearning_tpu.config import load_config
+from distributeddeeplearning_tpu.version import __version__
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="ddlt",
+        description="TPU-native distributed deep learning control plane.",
+    )
+    parser.add_argument("--env-file", default=None, help="Path to .env (default: ./.env)")
+    sub = parser.add_subparsers(dest="command")
+
+    sub.add_parser("version", help="Print framework version")
+
+    config_p = sub.add_parser("config", help="Configuration inspection")
+    config_sub = config_p.add_subparsers(dest="config_command")
+    config_sub.add_parser("show", help="Print resolved configuration")
+
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.command == "version":
+        print(__version__)
+        return 0
+    if args.command == "config":
+        if getattr(args, "config_command", None) == "show":
+            cfg = load_config(args.env_file)
+            for key in sorted(cfg.values):
+                print(f"{key}={cfg.values[key]}")
+            return 0
+        parser.parse_args(["config", "--help"])
+        return 2
+    parser.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

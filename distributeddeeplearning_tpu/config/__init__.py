@@ -1,0 +1,20 @@
+from distributeddeeplearning_tpu.config.env import load_env, parse_env, set_key, unset_key
+from distributeddeeplearning_tpu.config.settings import (
+    DEFAULTS,
+    Settings,
+    load_config,
+    str_to_bool,
+    write_env_template,
+)
+
+__all__ = [
+    "DEFAULTS",
+    "Settings",
+    "load_config",
+    "load_env",
+    "parse_env",
+    "set_key",
+    "str_to_bool",
+    "unset_key",
+    "write_env_template",
+]

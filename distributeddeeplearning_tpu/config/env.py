@@ -1,0 +1,96 @@
+"""Minimal ``.env`` file codec.
+
+The reference framework stores all project configuration in a ``.env`` file
+loaded with python-dotenv (``control/src/config.py:5-15``) and writes
+discovered values back with ``dotenv.set_key`` (``tasks.py:67-70``,
+``scripts/storage.py:77-78``).  This module provides the same contract with no
+third-party dependency: ``load_env`` parses ``KEY=VALUE`` lines (with
+``export`` prefixes, quotes, blank lines and ``#`` comments), ``set_key``
+rewrites a single key in place preserving the rest of the file, and
+``unset_key`` removes one.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from pathlib import Path
+from typing import Dict, Optional
+
+_LINE_RE = re.compile(
+    r"""^\s*(?:export\s+)?(?P<key>[A-Za-z_][A-Za-z0-9_]*)\s*=\s*(?P<value>.*?)\s*$"""
+)
+
+
+def _unquote(value: str) -> str:
+    if len(value) >= 2 and value[0] == value[-1] and value[0] in ("'", '"'):
+        inner = value[1:-1]
+        if value[0] == '"':
+            # Reverse the escaping applied by _quote_if_needed.
+            inner = inner.replace('\\"', '"').replace("\\\\", "\\")
+        return inner
+    return value
+
+
+def _quote_if_needed(value: str) -> str:
+    if value == "" or re.search(r"[\s#'\"\\]", value):
+        escaped = value.replace("\\", "\\\\").replace('"', '\\"')
+        return f'"{escaped}"'
+    return value
+
+
+def parse_env(text: str) -> Dict[str, str]:
+    """Parse the contents of a ``.env`` file into a dict (last key wins)."""
+    result: Dict[str, str] = {}
+    for raw_line in text.splitlines():
+        line = raw_line.strip()
+        if not line or line.startswith("#"):
+            continue
+        match = _LINE_RE.match(raw_line)
+        if match:
+            result[match.group("key")] = _unquote(match.group("value"))
+    return result
+
+
+def load_env(path: os.PathLike | str = ".env") -> Dict[str, str]:
+    """Load a ``.env`` file; missing files yield an empty dict."""
+    path = Path(path)
+    if not path.exists():
+        return {}
+    return parse_env(path.read_text())
+
+
+def set_key(path: os.PathLike | str, key: str, value: str) -> None:
+    """Set ``key=value`` in the env file, editing in place if the key exists.
+
+    Mirrors ``dotenv.set_key`` as used by the reference to persist the
+    selected subscription id and harvested storage keys.
+    """
+    path = Path(path)
+    new_line = f"{key}={_quote_if_needed(value)}"
+    if not path.exists():
+        path.write_text(new_line + "\n")
+        return
+    lines = path.read_text().splitlines()
+    replaced = False
+    for i, raw_line in enumerate(lines):
+        match = _LINE_RE.match(raw_line)
+        if match and match.group("key") == key and not raw_line.lstrip().startswith("#"):
+            lines[i] = new_line
+            replaced = True
+    if not replaced:
+        lines.append(new_line)
+    path.write_text("\n".join(lines) + "\n")
+
+
+def unset_key(path: os.PathLike | str, key: str) -> None:
+    path = Path(path)
+    if not path.exists():
+        return
+    kept = []
+    for raw_line in path.read_text().splitlines():
+        match = _LINE_RE.match(raw_line)
+        if match and match.group("key") == key and not raw_line.lstrip().startswith("#"):
+            continue
+        kept.append(raw_line)
+    path.write_text("\n".join(kept) + ("\n" if kept else ""))

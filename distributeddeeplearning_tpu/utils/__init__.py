@@ -1,0 +1,24 @@
+from distributeddeeplearning_tpu.utils.logging_utils import get_logger, is_primary, setup_logging
+from distributeddeeplearning_tpu.utils.metrics import (
+    AverageMeter,
+    accuracy_topk,
+    confidence_interval_95,
+    pmean_metrics,
+    topk_correct,
+)
+from distributeddeeplearning_tpu.utils.throughput import ExamplesPerSecondTracker
+from distributeddeeplearning_tpu.utils.timer import Timer, timer
+
+__all__ = [
+    "AverageMeter",
+    "ExamplesPerSecondTracker",
+    "Timer",
+    "accuracy_topk",
+    "confidence_interval_95",
+    "get_logger",
+    "is_primary",
+    "pmean_metrics",
+    "setup_logging",
+    "timer",
+    "topk_correct",
+]

@@ -1,0 +1,72 @@
+"""Logging setup.
+
+The reference selects an INI ``fileConfig`` via the ``LOG_CONFIG`` env var at
+every entry point (``control/src/logging.conf``, per-workload confs; wired at
+``resnet_main.py:311``, ``tasks.py:20``).  We keep that contract — honour
+``LOG_CONFIG`` when set — and otherwise configure a sane default that prefixes
+records with the JAX process index so multi-host logs are attributable.
+"""
+
+from __future__ import annotations
+
+import logging
+import logging.config
+import os
+from typing import Optional
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+class _ProcessIndexFilter(logging.Filter):
+    """Stamps each record with the *current* JAX process index.
+
+    Resolved lazily per record (not baked into the format string at setup
+    time) so logging configured before ``jax.distributed.initialize()`` still
+    attributes records correctly on every host afterwards.
+    """
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.process_index = _process_index()
+        return True
+
+
+def setup_logging(name: str = "ddlt", level: int = logging.INFO) -> logging.Logger:
+    """Configure logging once; returns the framework logger."""
+    log_config = os.environ.get("LOG_CONFIG", "")
+    if log_config and os.path.exists(log_config):
+        logging.config.fileConfig(log_config, disable_existing_loggers=False)
+    else:
+        root = logging.getLogger()
+        if not root.handlers:
+            handler = logging.StreamHandler()
+            handler.addFilter(_ProcessIndexFilter())
+            handler.setFormatter(
+                logging.Formatter(
+                    fmt="%(asctime)s [p%(process_index)s] %(levelname)s %(name)s: %(message)s",
+                    datefmt="%H:%M:%S",
+                )
+            )
+            root.addHandler(handler)
+            root.setLevel(level)
+    return logging.getLogger(name)
+
+
+def get_logger(name: Optional[str] = None) -> logging.Logger:
+    return logging.getLogger(name or "ddlt")
+
+
+def is_primary() -> bool:
+    """True on the process that should own side effects (checkpoints, TB).
+
+    The rank-0-only discipline of the reference (``_is_master``,
+    ``resnet_main.py:174-181``; ``hvd.rank()==0`` guards) expressed in JAX
+    terms.
+    """
+    return _process_index() == 0

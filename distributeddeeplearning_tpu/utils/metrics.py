@@ -1,0 +1,80 @@
+"""Training metrics: running averages, top-k accuracy, cross-replica reduction.
+
+Parity targets in the reference:
+- ``AverageMeter`` / ``accuracy`` (``PyTorch_imagenet/src/imagenet_pytorch_horovod.py:128-163``)
+- allreduce-averaged ``Metric`` (``PyTorch_hvd/src/imagenet_pytorch_horovod.py:239-251``)
+
+TPU-native design: accuracy and loss are computed *inside* the jitted step and
+reduced with ``jax.lax.pmean`` over the mesh (no host-side allreduce); the
+host-side meters here only aggregate already-reduced scalars over time.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class AverageMeter:
+    """Tracks current value, running sum, and average of a scalar stream."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.reset()
+
+    def reset(self) -> None:
+        self.val = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val: float, n: int = 1) -> None:
+        val = float(val)
+        self.val = val
+        self.sum += val * n
+        self.count += n
+
+    @property
+    def avg(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+
+def topk_correct(logits: jnp.ndarray, labels: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Number of examples whose true label is within the top-k logits.
+
+    jit-safe (static k); used inside eval steps.
+    """
+    k = min(k, logits.shape[-1])
+    _, top_idx = jax.lax.top_k(logits, k)
+    hit = jnp.any(top_idx == labels[:, None], axis=-1)
+    return jnp.sum(hit.astype(jnp.float32))
+
+
+def accuracy_topk(
+    logits: jnp.ndarray, labels: jnp.ndarray, ks: Tuple[int, ...] = (1, 5)
+) -> Dict[str, jnp.ndarray]:
+    """Top-k accuracies as fractions in [0, 1] (reference reports percent)."""
+    batch = logits.shape[0]
+    return {f"top{k}": topk_correct(logits, labels, k) / batch for k in ks}
+
+
+def pmean_metrics(metrics: Dict[str, jnp.ndarray], axis_name: str) -> Dict[str, jnp.ndarray]:
+    """Cross-replica mean of a metrics dict, inside pmap/shard_map bodies.
+
+    The XLA-collective replacement for the reference's host-side
+    ``hvd.allreduce`` averaging ``Metric`` class.
+    """
+    return {k: jax.lax.pmean(v, axis_name) for k, v in metrics.items()}
+
+
+def confidence_interval_95(samples) -> Tuple[float, float]:
+    """mean ± 1.96·σ of a sample list — the reference benchmark's reporting
+    convention (``pytorch_synthetic_benchmark.py:119-122``)."""
+    n = len(samples)
+    if n == 0:
+        return 0.0, 0.0
+    mean = sum(samples) / n
+    var = sum((s - mean) ** 2 for s in samples) / n
+    return mean, 1.96 * math.sqrt(var)

@@ -1,0 +1,33 @@
+"""Test harness: fake an 8-device TPU pod on CPU.
+
+SURVEY.md §4: the reference de-risks multi-node behavior through a local
+single-GPU path with the DISTRIBUTED switch off.  The JAX-native analogue is a
+virtual multi-device CPU platform, which lets every data-parallel semantic
+(mesh construction, psum gradient sync, sharded batches, LR scaling, resume)
+run in CI with no TPU attached.
+
+The interactive environment registers a real-TPU PJRT plugin at interpreter
+startup and pins JAX_PLATFORMS, so env vars alone are not enough: we must
+flip the platform via jax.config before the backend is first queried.
+"""
+
+import os
+
+# Must precede backend initialization (first jax.devices()/jit call).
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_env(tmp_path):
+    """A throwaway .env path."""
+    return tmp_path / ".env"

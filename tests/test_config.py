@@ -1,0 +1,112 @@
+import os
+
+from distributeddeeplearning_tpu.config import (
+    DEFAULTS,
+    load_config,
+    load_env,
+    parse_env,
+    set_key,
+    str_to_bool,
+    unset_key,
+    write_env_template,
+)
+
+
+def test_parse_env_basics():
+    text = """
+# comment
+FOO=bar
+export BAZ=qux
+QUOTED="hello world"
+SINGLE='x y'
+EMPTY=
+SPACED =  padded
+"""
+    env = parse_env(text)
+    assert env["FOO"] == "bar"
+    assert env["BAZ"] == "qux"
+    assert env["QUOTED"] == "hello world"
+    assert env["SINGLE"] == "x y"
+    assert env["EMPTY"] == ""
+    assert env["SPACED"] == "padded"
+
+
+def test_set_key_roundtrip(tmp_env):
+    set_key(tmp_env, "A", "1")
+    set_key(tmp_env, "B", "two words")
+    set_key(tmp_env, "A", "2")
+    env = load_env(tmp_env)
+    assert env == {"A": "2", "B": "two words"}
+    # In-place edit: file has exactly two assignments.
+    assert tmp_env.read_text().count("=") == 2
+
+
+def test_unset_key(tmp_env):
+    set_key(tmp_env, "A", "1")
+    set_key(tmp_env, "B", "2")
+    unset_key(tmp_env, "A")
+    assert load_env(tmp_env) == {"B": "2"}
+
+
+def test_load_config_layering(tmp_env, monkeypatch):
+    set_key(tmp_env, "TPU_NAME", "from-file")
+    set_key(tmp_env, "GCS_BUCKET", "file-bucket")
+    monkeypatch.setenv("GCS_BUCKET", "env-bucket")
+    cfg = load_config(tmp_env, overrides={"epochs": 3})
+    assert cfg.TPU_NAME == "from-file"  # file beats default
+    assert cfg.GCS_BUCKET == "env-bucket"  # process env beats file
+    assert cfg.get_int("EPOCHS") == 3  # override beats everything
+    assert cfg.TPU_TYPE == DEFAULTS["TPU_TYPE"]  # default survives
+
+
+def test_settings_persist_writes_back(tmp_env):
+    cfg = load_config(tmp_env)
+    cfg.persist("GCS_BUCKET", "discovered-bucket")
+    assert load_env(tmp_env)["GCS_BUCKET"] == "discovered-bucket"
+    cfg2 = load_config(tmp_env)
+    assert cfg2.GCS_BUCKET == "discovered-bucket"
+
+
+def test_write_env_template(tmp_path):
+    path = tmp_path / ".env"
+    write_env_template(path, gcp_project="proj-x")
+    env = load_env(path)
+    assert env["GCP_PROJECT"] == "proj-x"
+    assert "TPU_TYPE" in env
+
+
+def test_str_to_bool():
+    assert str_to_bool("True") and str_to_bool("yes") and str_to_bool("1")
+    assert not (str_to_bool("false") or str_to_bool("N") or str_to_bool("0"))
+    try:
+        str_to_bool("maybe")
+        assert False
+    except ValueError:
+        pass
+
+
+def test_get_bool_and_int_defaults(tmp_env):
+    cfg = load_config(tmp_env)
+    assert cfg.get_bool("DISTRIBUTED", default=False) is False
+    assert cfg.get_int("FAKE_DATA_LENGTH", default=128) == 128
+
+
+def test_quoted_value_roundtrip(tmp_env):
+    # Backslashes and quotes must survive a save/load cycle unchanged.
+    from distributeddeeplearning_tpu.config.env import load_env, set_key
+
+    tricky = 'pa"ss\\word with spaces'
+    set_key(tmp_env, "SECRET", tricky)
+    assert load_env(tmp_env)["SECRET"] == tricky
+    set_key(tmp_env, "SECRET", tricky)  # idempotent second save
+    assert load_env(tmp_env)["SECRET"] == tricky
+
+
+def test_persist_without_existing_env(tmp_path, monkeypatch):
+    # persist() must write back even when no .env existed at load time.
+    monkeypatch.chdir(tmp_path)
+    from distributeddeeplearning_tpu.config import load_config, load_env
+
+    cfg = load_config()
+    cfg.persist("GCS_BUCKET", "fresh-bucket")
+    assert load_env(tmp_path / ".env")["GCS_BUCKET"] == "fresh-bucket"

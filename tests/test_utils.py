@@ -1,0 +1,86 @@
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributeddeeplearning_tpu.utils import (
+    AverageMeter,
+    ExamplesPerSecondTracker,
+    Timer,
+    accuracy_topk,
+    confidence_interval_95,
+    pmean_metrics,
+)
+
+
+def test_timer_context_manager():
+    with Timer() as t:
+        time.sleep(0.01)
+    assert 0.005 < t.elapsed < 1.0
+    # elapsed frozen after stop
+    e1 = t.elapsed
+    time.sleep(0.005)
+    assert t.elapsed == e1
+
+
+def test_timer_decorator_and_report():
+    messages = []
+
+    @Timer(report=messages.append, prefix="work")
+    def work():
+        return 42
+
+    assert work() == 42
+    assert len(messages) == 1 and messages[0].startswith("work:")
+
+
+def test_average_meter():
+    m = AverageMeter("loss")
+    m.update(2.0, n=2)
+    m.update(4.0)
+    assert m.val == 4.0
+    assert abs(m.avg - (2.0 * 2 + 4.0) / 3) < 1e-9
+
+
+def test_accuracy_topk():
+    logits = jnp.array(
+        [
+            [0.1, 0.9, 0.0, 0.0],  # top1 = 1
+            [0.5, 0.1, 0.3, 0.1],  # top1 = 0, label 2 in top-2
+        ]
+    )
+    labels = jnp.array([1, 2])
+    acc = accuracy_topk(logits, labels, ks=(1, 2))
+    assert float(acc["top1"]) == 0.5
+    assert float(acc["top2"]) == 1.0
+
+
+def test_pmean_metrics_across_devices():
+    n = jax.device_count()
+    assert n == 8, "conftest must fake 8 devices"
+
+    def body(x):
+        return pmean_metrics({"loss": x}, axis_name="dp")
+
+    out = jax.pmap(body, axis_name="dp")(jnp.arange(n, dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(out["loss"]), np.full(n, (n - 1) / 2.0))
+
+
+def test_confidence_interval():
+    mean, half = confidence_interval_95([1.0, 1.0, 1.0])
+    assert mean == 1.0 and half == 0.0
+    mean, half = confidence_interval_95([0.0, 2.0])
+    assert mean == 1.0 and abs(half - 1.96) < 1e-9
+
+
+def test_examples_per_second_tracker():
+    logs = []
+    tr = ExamplesPerSecondTracker(global_batch_size=10, every_n_steps=2, report=logs.append)
+    tr.begin()
+    time.sleep(0.01)
+    tr.after_step()
+    tr.after_step()
+    assert len(logs) == 1
+    assert tr.average_examples_per_sec > 0
+    assert tr.summary(total_examples=20) > 0
