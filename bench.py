@@ -1,0 +1,105 @@
+"""Headline benchmark: ResNet-50 synthetic training throughput per chip.
+
+Mirrors the reference's benchmark methodology exactly
+(``PyTorch_benchmark/src/pytorch_synthetic_benchmark.py:106-126`` and
+tf_cnn_benchmarks submit settings ``tensorflow_benchmark.py:44-56``):
+batch 256/chip (the tf_cnn_benchmarks setting), mixed precision (bf16 here,
+fp16 there), fixed device-resident synthetic batch, warmup then timed
+iterations, img/sec mean ±1.96σ.  The timed unit is the full jitted train
+step (fwd+bwd+update — allreduce included when >1 chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` normalizes against 720 img/sec — a representative
+tf_cnn_benchmarks ResNet-50 fp16 bs-256 single-V100 figure (the reference
+publishes no numbers, BASELINE.md; 10% above/below this is the target band).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+V100_TF_CNN_BENCHMARKS_IMG_SEC = 720.0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--model", default="resnet50")
+    parser.add_argument("--num-iters", type=int, default=5)
+    parser.add_argument("--num-batches-per-iter", type=int, default=20)
+    parser.add_argument("--num-warmup", type=int, default=10)
+    parser.add_argument(
+        "--small", action="store_true", help="tiny shapes for CI smoke"
+    )
+    args = parser.parse_args()
+
+    if args.small:
+        args.batch_size, args.image_size = 16, 64
+        args.num_iters, args.num_batches_per_iter, args.num_warmup = 2, 2, 1
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributeddeeplearning_tpu.data.synthetic import synthetic_batch
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.parallel import (
+        MeshSpec,
+        create_mesh,
+        shard_batch,
+    )
+    from distributeddeeplearning_tpu.train.benchmark import run_benchmark
+    from distributeddeeplearning_tpu.train.schedule import goyal_lr_schedule
+    from distributeddeeplearning_tpu.train.state import (
+        create_train_state,
+        sgd_momentum,
+    )
+    from distributeddeeplearning_tpu.train.step import build_train_step
+
+    mesh = create_mesh(MeshSpec())
+    n_dev = mesh.devices.size
+    global_batch = args.batch_size * n_dev
+    img_shape = (args.image_size, args.image_size, 3)
+
+    model = get_model(args.model, num_classes=1001, dtype=jnp.bfloat16)
+    sched = goyal_lr_schedule(0.0125, n_dev, steps_per_epoch=5004)
+    tx = sgd_momentum(sched)
+    state = create_train_state(
+        jax.random.key(0), model, (args.batch_size, *img_shape), tx
+    )
+    step = build_train_step(mesh, state, schedule=sched)
+    batch = shard_batch(mesh, synthetic_batch(global_batch, img_shape))
+
+    result = run_benchmark(
+        step,
+        state,
+        batch,
+        model_name=args.model,
+        batch_size_per_chip=args.batch_size,
+        num_devices=n_dev,
+        num_warmup_batches=args.num_warmup,
+        num_iters=args.num_iters,
+        num_batches_per_iter=args.num_batches_per_iter,
+        log=lambda msg: print(msg, file=sys.stderr),
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": f"{args.model}_synthetic_train_img_sec_per_chip",
+                "value": round(result.img_sec_per_chip_mean, 1),
+                "unit": "img/sec/chip",
+                "vs_baseline": round(
+                    result.img_sec_per_chip_mean / V100_TF_CNN_BENCHMARKS_IMG_SEC, 3
+                ),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

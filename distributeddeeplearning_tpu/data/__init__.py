@@ -1,0 +1,17 @@
+"""Data plane: synthetic fixtures, ImageNet preparation, TFRecord IO,
+preprocessing.
+
+Parity map (SURVEY.md §2):
+- ``synthetic``   ↔ 16h ``data/synthetic.py`` + PyTorch ``FakeData``
+- ``preprocessing`` ↔ 16g ``imagenet_preprocessing.py``
+- ``tfrecords``   ↔ 16e ``data/tfrecords.py`` (reader) + 14 converter
+- ``images``      ↔ 16f ``data/images.py`` raw-JPEG loader
+- ``prepare_imagenet`` ↔ 13 ``scripts/prepare_imagenet.py``
+"""
+
+from distributeddeeplearning_tpu.data.synthetic import (
+    SyntheticDataset,
+    synthetic_batches,
+)
+
+__all__ = ["SyntheticDataset", "synthetic_batches"]

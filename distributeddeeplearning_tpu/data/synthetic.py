@@ -1,0 +1,104 @@
+"""Synthetic input pipelines — the framework's fake-data backend.
+
+Parity with both reference fakes (SURVEY.md §4.3):
+- TF ``get_synth_input_fn`` — random tensors at the training shape for
+  input-bound upper-throughput measurement
+  (``TensorFlow_imagenet/src/data/synthetic.py:4-52``)
+- PyTorch ``FakeData`` — a sized fake Dataset honouring ``FAKE_DATA_LENGTH``
+  to shrink epochs in tests (``imagenet_pytorch_horovod.py:45-47,81-125``)
+
+TPU-native twist: the benchmark path keeps ONE device-resident batch and
+reuses it every step (like ``pytorch_synthetic_benchmark.py:81-84`` keeps the
+batch on-GPU) so measured img/sec is pure compute+collective throughput, not
+host RNG speed.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+Batch = Dict[str, np.ndarray]
+
+DEFAULT_IMAGE_SHAPE = (224, 224, 3)  # NHWC — TPU-native layout
+
+
+def fake_data_length(default: int = 1281167) -> int:
+    """Epoch length override — the reference's ``FAKE_DATA_LENGTH`` env
+    contract (``imagenet_pytorch_horovod.py:45-47``)."""
+    val = os.environ.get("FAKE_DATA_LENGTH", "")
+    return int(val) if val else default
+
+
+class SyntheticDataset:
+    """Sized, deterministic fake classification dataset (FakeData parity)."""
+
+    def __init__(
+        self,
+        length: Optional[int] = None,
+        image_shape: Tuple[int, ...] = DEFAULT_IMAGE_SHAPE,
+        num_classes: int = 1001,
+        seed: int = 42,
+        dtype: np.dtype = np.float32,
+    ):
+        self.length = fake_data_length() if length is None else length
+        self.image_shape = image_shape
+        self.num_classes = num_classes
+        self.seed = seed
+        self.dtype = dtype
+
+    def __len__(self) -> int:
+        return self.length
+
+    def batches(
+        self, batch_size: int, *, drop_remainder: bool = True
+    ) -> Iterator[Batch]:
+        """Yield host-local batches for one epoch."""
+        rng = np.random.default_rng(self.seed)
+        n_batches = self.length // batch_size
+        if not drop_remainder and self.length % batch_size:
+            n_batches += 1
+        for i in range(n_batches):
+            size = min(batch_size, self.length - i * batch_size)
+            yield {
+                "image": rng.standard_normal(
+                    (size, *self.image_shape), dtype=np.float32
+                ).astype(self.dtype),
+                "label": rng.integers(0, self.num_classes, size=(size,), dtype=np.int32),
+            }
+
+
+def synthetic_batch(
+    batch_size: int,
+    image_shape: Tuple[int, ...] = DEFAULT_IMAGE_SHAPE,
+    num_classes: int = 1001,
+    seed: int = 0,
+    dtype: np.dtype = np.float32,
+) -> Batch:
+    """One fixed random batch — the benchmark's resident batch
+    (``pytorch_synthetic_benchmark.py:81-84``)."""
+    rng = np.random.default_rng(seed)
+    return {
+        "image": rng.standard_normal((batch_size, *image_shape), dtype=np.float32).astype(
+            dtype
+        ),
+        "label": rng.integers(0, num_classes, size=(batch_size,), dtype=np.int32),
+    }
+
+
+def synthetic_batches(
+    batch_size: int,
+    steps: int,
+    image_shape: Tuple[int, ...] = DEFAULT_IMAGE_SHAPE,
+    num_classes: int = 1001,
+    seed: int = 0,
+) -> Iterator[Batch]:
+    """Stream of distinct random batches (get_synth_input_fn parity)."""
+    rng = np.random.default_rng(seed)
+    for _ in range(steps):
+        yield {
+            "image": rng.standard_normal((batch_size, *image_shape), dtype=np.float32),
+            "label": rng.integers(0, num_classes, size=(batch_size,), dtype=np.int32),
+        }

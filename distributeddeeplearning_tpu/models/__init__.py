@@ -1,0 +1,41 @@
+"""Model zoo.
+
+Parity targets: the reference trains torchvision ``resnet50`` / arbitrary
+torchvision models by name (``pytorch_synthetic_benchmark.py:60``,
+``imagenet_pytorch_horovod.py:383``), a graph-mode ResNet v1 generator for
+18/34/50/101/152/200 (``TensorFlow_imagenet/src/resnet_model.py``), and
+tf_cnn_benchmarks' ResNet-50/InceptionV3 (``tensorflow_benchmark.py:44-56``).
+
+``get_model(name)`` is the by-name factory playing the role of
+``getattr(torchvision.models, model)``.
+"""
+
+from typing import Any, Callable, Dict
+
+_REGISTRY: Dict[str, Callable[..., Any]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_model(name: str, **kwargs):
+    """Instantiate a model by name — parity with the reference's
+    ``models.__dict__[args.model]()`` (``pytorch_synthetic_benchmark.py:60``)."""
+    # import for registration side effects
+    from distributeddeeplearning_tpu.models import resnet, inception, bert  # noqa: F401
+
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown model {name!r}. Available: {sorted(_REGISTRY)}")
+    return _REGISTRY[key](**kwargs)
+
+
+def available_models():
+    from distributeddeeplearning_tpu.models import resnet, inception, bert  # noqa: F401
+
+    return sorted(_REGISTRY)

@@ -1,0 +1,254 @@
+"""BERT-style transformer encoder — TPU-native flax implementation.
+
+The reference has no attention model (SURVEY.md §5 "Long-context… entirely
+absent"), but BASELINE.md tracks a "BERT-base fine-tune pod-scale DP" config,
+and the framework treats long-context/distributed attention as first-class.
+This module supplies the encoder with **logical axis annotations** on every
+parameter so one model definition serves all parallelism modes:
+
+    logical axis   DP rule    FSDP rule    TP rule
+    "embed"        replicate  shard fsdp   shard fsdp
+    "mlp"          replicate  shard fsdp   shard tensor   (column-parallel)
+    "heads"        replicate  shard fsdp   shard tensor   (attention heads)
+    "vocab"        replicate  replicate    replicate
+
+Activations carry logical names ("batch", "seq", "embed") via
+``nn.with_logical_constraint`` so sequence parallelism is a rules change
+(map "seq" → the mesh's seq axis), not a model change.  The attention
+primitive is injectable: the default is plain fused dot-product attention
+(XLA emits an MXU-friendly kernel); ring attention from ``ops.ring_attention``
+slots in for sequence-parallel long-context runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.models import register
+
+AttentionFn = Callable[..., jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dropout_rate: float = 0.1
+    num_classes: int = 2  # sequence-classification head (fine-tune target)
+
+
+BERT_BASE = BertConfig()
+BERT_LARGE = BertConfig(
+    hidden_size=1024, num_layers=24, num_heads=16, intermediate_size=4096
+)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array],
+    *,
+    dtype: jnp.dtype,
+) -> jax.Array:
+    """Default attention: [B, S, H, D] inputs, fp32 softmax, bf16 matmuls."""
+    depth = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(q.dtype)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def _dense(features, logical_axes, dtype, name):
+    return nn.DenseGeneral(
+        features,
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=0.02), logical_axes
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros, logical_axes[-1:] if len(logical_axes) == 2 else logical_axes[1:]
+        ),
+        name=name,
+    )
+
+
+class SelfAttention(nn.Module):
+    config: BertConfig
+    dtype: jnp.dtype = jnp.bfloat16
+    attention_fn: AttentionFn = dot_product_attention
+
+    @nn.compact
+    def __call__(self, x, mask, train: bool):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        qkv = lambda name: nn.DenseGeneral(
+            (cfg.num_heads, head_dim),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("embed", "heads", "kv")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("heads", "kv")
+            ),
+            name=name,
+        )
+        q, k, v = qkv("query")(x), qkv("key")(x), qkv("value")(x)
+        attn = self.attention_fn(q, k, v, mask, dtype=self.dtype)
+        out = nn.DenseGeneral(
+            cfg.hidden_size,
+            axis=(-2, -1),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("heads", "kv", "embed")
+            ),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+            name="out",
+        )(attn)
+        return out
+
+
+class EncoderLayer(nn.Module):
+    config: BertConfig
+    dtype: jnp.dtype = jnp.bfloat16
+    attention_fn: AttentionFn = dot_product_attention
+
+    @nn.compact
+    def __call__(self, x, mask, train: bool):
+        cfg = self.config
+        # Post-LN (BERT) ordering.
+        attn = SelfAttention(cfg, self.dtype, self.attention_fn, name="attention")(
+            x, mask, train
+        )
+        if cfg.dropout_rate:
+            attn = nn.Dropout(cfg.dropout_rate, deterministic=not train)(attn)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="attention_ln")(x + attn)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        h = _dense(cfg.intermediate_size, ("embed", "mlp"), self.dtype, "mlp_in")(x)
+        h = nn.gelu(h, approximate=False)
+        h = _dense(cfg.hidden_size, ("mlp", "embed"), self.dtype, "mlp_out")(h)
+        if cfg.dropout_rate:
+            h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="mlp_ln")(x + h)
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class BertEncoder(nn.Module):
+    """Token/position/type embeddings + N encoder layers + pooler + head.
+
+    Input contract (dict or positional): ``input_ids`` [B, S] int32,
+    optional ``attention_mask`` [B, S] (1 = attend), ``token_type_ids``.
+    Returns classification logits [B, num_classes] (fp32).
+    """
+
+    config: BertConfig = BERT_BASE
+    dtype: jnp.dtype = jnp.bfloat16
+    attention_fn: AttentionFn = dot_product_attention
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        train: bool = True,
+        attention_mask=None,
+        token_type_ids=None,
+    ):
+        cfg = self.config
+        if input_ids.dtype != jnp.int32:
+            input_ids = input_ids.astype(jnp.int32)
+        B, S = input_ids.shape
+
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.hidden_size,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")
+            ),
+            name="token_embed",
+        )(input_ids)
+        pos = nn.Embed(
+            cfg.max_position_embeddings,
+            cfg.hidden_size,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), (None, "embed")
+            ),
+            name="position_embed",
+        )(jnp.arange(S)[None, :])
+        x = embed + pos
+        if token_type_ids is not None:
+            x = x + nn.Embed(
+                cfg.type_vocab_size,
+                cfg.hidden_size,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                name="type_embed",
+            )(token_type_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="embed_ln")(x)
+        if cfg.dropout_rate:
+            x = nn.Dropout(cfg.dropout_rate, deterministic=not train)(x)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        for i in range(cfg.num_layers):
+            x = EncoderLayer(cfg, self.dtype, self.attention_fn, name=f"layer{i}")(
+                x, mask, train
+            )
+
+        # pooler: tanh(dense(CLS)) then classification head
+        cls = x[:, 0]
+        pooled = nn.tanh(
+            _dense(cfg.hidden_size, ("embed", "embed_out"), self.dtype, "pooler")(cls)
+        )
+        logits = nn.Dense(
+            cfg.num_classes, dtype=self.dtype, param_dtype=jnp.float32, name="head"
+        )(pooled)
+        return logits.astype(jnp.float32)
+
+
+@register("bert-base")
+@register("bert_base")
+def bert_base(**kwargs):
+    cfg_kwargs = {
+        f.name: kwargs.pop(f.name)
+        for f in dataclasses.fields(BertConfig)
+        if f.name in kwargs
+    }
+    cfg = dataclasses.replace(BERT_BASE, **cfg_kwargs)
+    return BertEncoder(config=cfg, **kwargs)
+
+
+@register("bert-large")
+def bert_large(**kwargs):
+    cfg_kwargs = {
+        f.name: kwargs.pop(f.name)
+        for f in dataclasses.fields(BertConfig)
+        if f.name in kwargs
+    }
+    cfg = dataclasses.replace(BERT_LARGE, **cfg_kwargs)
+    return BertEncoder(config=cfg, **kwargs)

@@ -1,0 +1,199 @@
+"""Inception v3 — TPU-native flax implementation.
+
+Parity target: the reference's TF benchmark submits InceptionV3 through
+tf_cnn_benchmarks (``TensorFlow_benchmark/tensorflow_benchmark.py:44-56``,
+model choice via ``--model``); BASELINE.md tracks "TensorFlow_benchmark
+ResNet50/InceptionV3 synthetic 1-replica".  The architecture follows the
+standard Inception v3 (Szegedy et al. 1512.00567): 299×299 input, stem,
+3×InceptionA, InceptionB, 4×InceptionC, InceptionD, 2×InceptionE, global
+pool, 1001-way head.  NHWC, bf16 activations / fp32 params-BN as elsewhere.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.models import register
+
+KernelSize = Union[int, Tuple[int, int]]
+
+
+class ConvBN(nn.Module):
+    """Conv + BN + ReLU, the Inception building block (bias-free conv)."""
+
+    features: int
+    kernel_size: KernelSize = 1
+    strides: int = 1
+    padding: str = "SAME"
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        ks = self.kernel_size
+        if isinstance(ks, int):
+            ks = (ks, ks)
+        x = nn.Conv(
+            self.features,
+            ks,
+            strides=(self.strides, self.strides),
+            padding=self.padding,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9997,
+            epsilon=1e-3,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        b1 = ConvBN(64, 1, dtype=self.dtype)(x, train)
+        b2 = ConvBN(48, 1, dtype=self.dtype)(x, train)
+        b2 = ConvBN(64, 5, dtype=self.dtype)(b2, train)
+        b3 = ConvBN(64, 1, dtype=self.dtype)(x, train)
+        b3 = ConvBN(96, 3, dtype=self.dtype)(b3, train)
+        b3 = ConvBN(96, 3, dtype=self.dtype)(b3, train)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = ConvBN(self.pool_features, 1, dtype=self.dtype)(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35→17."""
+
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        b1 = ConvBN(384, 3, strides=2, padding="VALID", dtype=self.dtype)(x, train)
+        b2 = ConvBN(64, 1, dtype=self.dtype)(x, train)
+        b2 = ConvBN(96, 3, dtype=self.dtype)(b2, train)
+        b2 = ConvBN(96, 3, strides=2, padding="VALID", dtype=self.dtype)(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7x7 branches."""
+
+    channels_7x7: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c7 = self.channels_7x7
+        b1 = ConvBN(192, 1, dtype=self.dtype)(x, train)
+        b2 = ConvBN(c7, 1, dtype=self.dtype)(x, train)
+        b2 = ConvBN(c7, (1, 7), dtype=self.dtype)(b2, train)
+        b2 = ConvBN(192, (7, 1), dtype=self.dtype)(b2, train)
+        b3 = ConvBN(c7, 1, dtype=self.dtype)(x, train)
+        b3 = ConvBN(c7, (7, 1), dtype=self.dtype)(b3, train)
+        b3 = ConvBN(c7, (1, 7), dtype=self.dtype)(b3, train)
+        b3 = ConvBN(c7, (7, 1), dtype=self.dtype)(b3, train)
+        b3 = ConvBN(192, (1, 7), dtype=self.dtype)(b3, train)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = ConvBN(192, 1, dtype=self.dtype)(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17→8."""
+
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        b1 = ConvBN(192, 1, dtype=self.dtype)(x, train)
+        b1 = ConvBN(320, 3, strides=2, padding="VALID", dtype=self.dtype)(b1, train)
+        b2 = ConvBN(192, 1, dtype=self.dtype)(x, train)
+        b2 = ConvBN(192, (1, 7), dtype=self.dtype)(b2, train)
+        b2 = ConvBN(192, (7, 1), dtype=self.dtype)(b2, train)
+        b2 = ConvBN(192, 3, strides=2, padding="VALID", dtype=self.dtype)(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded-filter-bank output blocks."""
+
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        b1 = ConvBN(320, 1, dtype=self.dtype)(x, train)
+        b2 = ConvBN(384, 1, dtype=self.dtype)(x, train)
+        b2 = jnp.concatenate(
+            [
+                ConvBN(384, (1, 3), dtype=self.dtype)(b2, train),
+                ConvBN(384, (3, 1), dtype=self.dtype)(b2, train),
+            ],
+            axis=-1,
+        )
+        b3 = ConvBN(448, 1, dtype=self.dtype)(x, train)
+        b3 = ConvBN(384, 3, dtype=self.dtype)(b3, train)
+        b3 = jnp.concatenate(
+            [
+                ConvBN(384, (1, 3), dtype=self.dtype)(b3, train),
+                ConvBN(384, (3, 1), dtype=self.dtype)(b3, train),
+            ],
+            axis=-1,
+        )
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = ConvBN(192, 1, dtype=self.dtype)(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1001
+    dtype: jnp.dtype = jnp.bfloat16
+    dropout_rate: float = 0.0  # benchmarks run without dropout
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        # stem: 299x299x3 → 35x35x192
+        x = ConvBN(32, 3, strides=2, padding="VALID", dtype=self.dtype)(x, train)
+        x = ConvBN(32, 3, padding="VALID", dtype=self.dtype)(x, train)
+        x = ConvBN(64, 3, dtype=self.dtype)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = ConvBN(80, 1, padding="VALID", dtype=self.dtype)(x, train)
+        x = ConvBN(192, 3, padding="VALID", dtype=self.dtype)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+        x = InceptionA(32, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = InceptionB(dtype=self.dtype)(x, train)
+        x = InceptionC(128, dtype=self.dtype)(x, train)
+        x = InceptionC(160, dtype=self.dtype)(x, train)
+        x = InceptionC(160, dtype=self.dtype)(x, train)
+        x = InceptionC(192, dtype=self.dtype)(x, train)
+        x = InceptionD(dtype=self.dtype)(x, train)
+        x = InceptionE(dtype=self.dtype)(x, train)
+        x = InceptionE(dtype=self.dtype)(x, train)
+
+        x = jnp.mean(x, axis=(1, 2))
+        if self.dropout_rate > 0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=jnp.float32, name="head"
+        )(x)
+        return x.astype(jnp.float32)
+
+
+register("inceptionv3")(InceptionV3)
+register("inception_v3")(InceptionV3)

@@ -1,0 +1,206 @@
+"""ResNet v1 family — TPU-native flax implementation.
+
+Capability parity with the reference's graph-mode generator
+(``TensorFlow_imagenet/src/resnet_model.py:14-320``): depths 18/34/50/101/152/
+200, residual (basic) blocks for 18/34 and bottleneck blocks for ≥50, BN+ReLU
+ordering of ResNet v1, fixed padding on strided convs, and the final
+1001-class head (``defaults.py:11`` NUM_CLASSES=1001 — class 0 is background).
+
+TPU-first design choices (not a translation):
+- **NHWC** layout with ``channels-last`` convs: XLA's TPU conv emitter tiles
+  NHWC onto the MXU directly (the reference defaults to NCHW for cuDNN —
+  ``resnet_main.py:218``; that choice is a GPU-ism).
+- **bf16 activations, fp32 params/BN statistics** via the ``dtype`` knob:
+  matmuls/convs hit the MXU at bf16 width with fp32 accumulation.
+- SAME-padded convs; XLA fuses pad+conv, no explicit fixed-pad op needed for
+  stride 1. Strided convs use the same explicit asymmetric padding as the
+  reference (``conv2d_fixed_padding``, ``resnet_model.py:119-139``) so
+  feature-map geometry (and thus accuracy) matches exactly.
+- BatchNorm with momentum 0.997 / eps 1e-5 matching ``resnet_model.py:29-31``;
+  under global-batch ``jit`` the batch statistics are computed over the global
+  (sharded) batch, i.e. cross-replica sync-BN — XLA inserts the per-channel
+  reduction on ICI.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.models import register
+
+ModuleDef = Any
+
+BN_MOMENTUM = 0.997  # resnet_model.py:29 (decay)
+BN_EPSILON = 1e-5  # resnet_model.py:30
+
+# depth -> (block, stage sizes); resnet_model.py:292-306
+RESNET_CONFIGS = {
+    18: ("basic", (2, 2, 2, 2)),
+    34: ("basic", (3, 4, 6, 3)),
+    50: ("bottleneck", (3, 4, 6, 3)),
+    101: ("bottleneck", (3, 4, 23, 3)),
+    152: ("bottleneck", (3, 8, 36, 3)),
+    200: ("bottleneck", (3, 24, 36, 3)),
+}
+
+
+def fixed_pad(x: jnp.ndarray, kernel_size: int) -> jnp.ndarray:
+    """Explicit asymmetric pad for strided convs (resnet_model.py:98-116):
+    pads by kernel_size-1 total, split beg/end, independent of input size."""
+    pad_total = kernel_size - 1
+    pad_beg = pad_total // 2
+    pad_end = pad_total - pad_beg
+    return jnp.pad(x, [(0, 0), (pad_beg, pad_end), (pad_beg, pad_end), (0, 0)])
+
+
+class ConvFixedPadding(nn.Module):
+    """conv2d_fixed_padding parity (resnet_model.py:119-139), NHWC."""
+
+    features: int
+    kernel_size: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        padding = "SAME"
+        if self.strides > 1:
+            x = fixed_pad(x, self.kernel_size)
+            padding = "VALID"
+        return nn.Conv(
+            self.features,
+            (self.kernel_size, self.kernel_size),
+            strides=(self.strides, self.strides),
+            padding=padding,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+        )(x)
+
+
+class BatchNormRelu(nn.Module):
+    """batch_norm_relu parity (resnet_model.py:23-95): BN then optional ReLU;
+    fp32 statistics regardless of activation dtype."""
+
+    relu: bool = True
+    init_zero: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=BN_MOMENTUM,
+            epsilon=BN_EPSILON,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            scale_init=nn.initializers.zeros if self.init_zero else nn.initializers.ones,
+        )(x)
+        if self.relu:
+            x = nn.relu(x)
+        return x
+
+
+class ResidualBlock(nn.Module):
+    """Basic 3x3+3x3 block for ResNet-18/34 (resnet_model.py:142-186)."""
+
+    features: int
+    strides: int
+    use_projection: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        shortcut = x
+        if self.use_projection:
+            shortcut = ConvFixedPadding(
+                self.features, 1, self.strides, dtype=self.dtype, name="proj_conv"
+            )(x)
+            shortcut = BatchNormRelu(relu=False, dtype=self.dtype, name="proj_bn")(
+                shortcut, train
+            )
+        x = ConvFixedPadding(self.features, 3, self.strides, dtype=self.dtype)(x)
+        x = BatchNormRelu(dtype=self.dtype)(x, train)
+        x = ConvFixedPadding(self.features, 3, 1, dtype=self.dtype)(x)
+        # final BN is zero-init so the block starts as identity (resnet_model.py:171-176)
+        x = BatchNormRelu(relu=False, init_zero=True, dtype=self.dtype)(x, train)
+        return nn.relu(x + shortcut)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 → 3x3 → 1x1(×4) block for ResNet-50+ (resnet_model.py:189-234)."""
+
+    features: int
+    strides: int
+    use_projection: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        shortcut = x
+        if self.use_projection:
+            shortcut = ConvFixedPadding(
+                4 * self.features, 1, self.strides, dtype=self.dtype, name="proj_conv"
+            )(x)
+            shortcut = BatchNormRelu(relu=False, dtype=self.dtype, name="proj_bn")(
+                shortcut, train
+            )
+        x = ConvFixedPadding(self.features, 1, 1, dtype=self.dtype)(x)
+        x = BatchNormRelu(dtype=self.dtype)(x, train)
+        x = ConvFixedPadding(self.features, 3, self.strides, dtype=self.dtype)(x)
+        x = BatchNormRelu(dtype=self.dtype)(x, train)
+        x = ConvFixedPadding(4 * self.features, 1, 1, dtype=self.dtype)(x)
+        x = BatchNormRelu(relu=False, init_zero=True, dtype=self.dtype)(x, train)
+        return nn.relu(x + shortcut)
+
+
+class ResNet(nn.Module):
+    """ResNet v1 (resnet_v1_generator parity, resnet_model.py:237-320)."""
+
+    depth: int = 50
+    num_classes: int = 1001  # defaults.py:11 — TF convention incl. background
+    dtype: jnp.dtype = jnp.bfloat16
+    width_multiplier: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        block_kind, stages = RESNET_CONFIGS[self.depth]
+        block = ResidualBlock if block_kind == "basic" else BottleneckBlock
+
+        x = x.astype(self.dtype)
+        # stem: 7x7/2 conv + BN/ReLU + 3x3/2 maxpool (resnet_model.py:308-320)
+        x = ConvFixedPadding(64 * self.width_multiplier, 7, 2, dtype=self.dtype, name="stem_conv")(x)
+        x = BatchNormRelu(dtype=self.dtype, name="stem_bn")(x, train)
+        x = fixed_pad(x, 3)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+        for i, num_blocks in enumerate(stages):
+            features = 64 * self.width_multiplier * (2**i)
+            strides = 1 if i == 0 else 2
+            x = block(
+                features, strides, use_projection=True, dtype=self.dtype,
+                name=f"stage{i + 1}_block1",
+            )(x, train)
+            for j in range(1, num_blocks):
+                x = block(
+                    features, 1, dtype=self.dtype, name=f"stage{i + 1}_block{j + 1}"
+                )(x, train)
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.initializers.normal(stddev=0.01),
+            name="head",
+        )(x)
+        return x.astype(jnp.float32)
+
+
+for _depth in RESNET_CONFIGS:
+    register(f"resnet{_depth}")(partial(ResNet, depth=_depth))

@@ -1,0 +1,46 @@
+"""Parallelism runtime: device meshes, shardings, collectives, multi-host init.
+
+This package is the TPU-native replacement for the reference's entire
+communication column — Horovod 0.15.2 over MPI with NCCL transport
+(SURVEY.md §5 "Distributed communication backend";
+``control/src/aml_compute.py:83-85,128``).  There is no NCCL, MPI, or
+nvidia-docker anywhere: XLA compiles ``psum``/``pmean``/``all_gather``
+collectives directly onto ICI within a pod slice and DCN across slices, and
+``jax.distributed.initialize`` replaces the mpirun rendezvous.
+"""
+
+from distributeddeeplearning_tpu.parallel.mesh import (
+    MeshSpec,
+    create_mesh,
+    local_device_count,
+    world_size,
+)
+from distributeddeeplearning_tpu.parallel.sharding import (
+    batch_sharding,
+    replicated,
+    shard_batch,
+    param_shardings,
+)
+from distributeddeeplearning_tpu.parallel.distributed import (
+    DistributedContext,
+    initialize,
+    is_primary,
+    process_count,
+    process_index,
+)
+
+__all__ = [
+    "MeshSpec",
+    "create_mesh",
+    "local_device_count",
+    "world_size",
+    "batch_sharding",
+    "replicated",
+    "shard_batch",
+    "param_shardings",
+    "DistributedContext",
+    "initialize",
+    "is_primary",
+    "process_count",
+    "process_index",
+]

@@ -1,0 +1,55 @@
+"""Collective helpers used inside jitted step functions.
+
+The reference uses exactly three collectives, all via Horovod/NCCL:
+allreduce of gradients (``hvd.DistributedOptimizer``), allreduce-averaged
+metrics (``PyTorch_hvd/src/imagenet_pytorch_horovod.py:239-251``), and
+broadcast of params/optimizer state (``imagenet_pytorch_horovod.py:401-409``).
+
+TPU-native, none of these are runtime calls: inside ``jit`` over a sharded
+batch, XLA inserts the gradient all-reduce automatically from sharding
+propagation, metrics reduce with ``lax.pmean`` (under ``shard_map``) or by
+plain ``jnp.mean`` over the global batch (under jit, where the array is
+global), and "broadcast" is just placing an array with a replicated sharding.
+These helpers exist for the explicit ``shard_map`` paths (ring attention,
+custom kernels) and for tests that pin down collective semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+AxisName = Union[str, Sequence[str]]
+
+
+def psum(tree: PyTree, axis: AxisName) -> PyTree:
+    return jax.tree_util.tree_map(lambda x: lax.psum(x, axis), tree)
+
+
+def pmean(tree: PyTree, axis: AxisName) -> PyTree:
+    return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis), tree)
+
+
+def all_gather(x: jax.Array, axis: AxisName, *, tiled: bool = True) -> jax.Array:
+    return lax.all_gather(x, axis, tiled=tiled)
+
+
+def ring_permute(x: jax.Array, axis: str, shift: int = 1) -> jax.Array:
+    """Send ``x`` to the next device along ``axis`` (ring step).
+
+    The building block of ring attention / ring allreduce: neighbour exchange
+    over ICI, which XLA lowers to a single hop with no host involvement.
+    """
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def global_norm(tree: PyTree) -> jax.Array:
+    """L2 norm over a pytree (for grad-norm logging / clipping)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.vdot(x, x).real for x in leaves))

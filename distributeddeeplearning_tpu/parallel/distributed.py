@@ -1,0 +1,120 @@
+"""Multi-host initialization and rank discipline.
+
+Replaces the reference's launch stack — AML ``distributed_backend="mpi"``
+(``aml_compute.py:128``), per-rank ``hvd.init()`` MPI rendezvous
+(``resnet_main.py:232``, ``imagenet_pytorch_horovod.py:48-53``), and the
+``DISTRIBUTED`` env switch that gates all of it
+(``aml_compute.py:74-96``, ``defaults.py:19-21``).
+
+TPU-native: one Python process per TPU host; ``jax.distributed.initialize``
+performs the rendezvous (coordinator address + process id from the TPU
+metadata server or explicit env); the ``DISTRIBUTED`` switch survives as the
+local-debug analogue — when unset/false and only one process exists, no
+rendezvous is attempted, matching the reference's single-GPU local path
+(``aml_compute.py:117`` routing to target "local").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger("ddlt.distributed")
+
+_TRUE = {"1", "true", "yes", "on"}
+
+
+def _env_flag(name: str, default: bool = False) -> bool:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.strip().lower() in _TRUE
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedContext:
+    """Resolved process geometry — the reference's (hvd.rank, hvd.size,
+    hvd.local_rank) triple (``pytorch_synthetic_benchmark.py:53-55``)."""
+
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+    distributed: bool
+
+    @property
+    def is_primary(self) -> bool:
+        return self.process_index == 0
+
+
+_context: Optional[DistributedContext] = None
+
+
+def initialize(
+    *,
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    force: Optional[bool] = None,
+) -> DistributedContext:
+    """Initialize multi-host JAX if requested; always return the context.
+
+    ``force=None`` consults the ``DISTRIBUTED`` env var — the same switch the
+    reference's training scripts key off (``aml_compute.py:90``).  On a real
+    multi-host TPU pod ``jax.distributed.initialize()`` with no arguments
+    discovers everything from the TPU metadata server.
+    """
+    global _context
+    if _context is not None:
+        return _context
+
+    want = force if force is not None else _env_flag("DISTRIBUTED")
+    if want:
+        kwargs = {}
+        if coordinator_address:
+            kwargs["coordinator_address"] = coordinator_address
+        if num_processes is not None:
+            kwargs["num_processes"] = num_processes
+        if process_id is not None:
+            kwargs["process_id"] = process_id
+        logger.info("jax.distributed.initialize(%s)", kwargs)
+        jax.distributed.initialize(**kwargs)
+
+    _context = DistributedContext(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+        distributed=want or jax.process_count() > 1,
+    )
+    if _context.is_primary:
+        logger.info(
+            "distributed context: %d processes × %d local devices = %d total",
+            _context.process_count,
+            _context.local_device_count,
+            _context.global_device_count,
+        )
+    return _context
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_primary() -> bool:
+    """Rank-0 logging/checkpoint discipline — the reference's
+    ``hvd.rank()==0`` / ``_is_master`` checks (``resnet_main.py:174-181``)."""
+    return jax.process_index() == 0
+
+
+def reset_context_for_testing() -> None:
+    global _context
+    _context = None

@@ -1,0 +1,136 @@
+"""Device mesh construction.
+
+The reference's process geometry is ``node_count × process_count_per_node=4``
+MPI ranks with one GPU pinned per rank (``control/src/aml_compute.py:108-133``,
+``resnet_main.py:142-145``).  On TPU the geometry is a *logical mesh* over the
+pod slice: one named axis per parallelism strategy, with XLA laying the
+resulting collectives onto ICI (within-slice) / DCN (across-slice) links.
+
+Axis convention (fixed names, used by every sharding rule in the framework):
+
+    data    — data parallelism (gradient psum), the reference's only strategy
+    fsdp    — parameter/optimizer sharding along the data axis (ZeRO-style)
+    tensor  — tensor/model parallelism (activations + weight shards)
+    seq     — sequence/context parallelism (ring attention)
+    expert  — expert parallelism for MoE layers
+    pipe    — pipeline parallelism stages
+
+A ``MeshSpec`` names the per-axis sizes; unspecified axes default to 1 and
+``data`` absorbs the remaining devices, so ``MeshSpec()`` on N chips is pure
+DP over N — exactly the reference's semantics (Horovod world = all GPUs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+logger = logging.getLogger("ddlt.mesh")
+
+# Canonical axis order: outermost (slowest-varying, crosses DCN first) to
+# innermost (fastest-varying, stays on ICI).  Data-parallel gradients tolerate
+# slow links best, tensor-parallel activations worst — so data/pipe go
+# outermost and tensor/seq innermost, matching the scaling-book recipe.
+AXIS_ORDER: Tuple[str, ...] = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
+
+DATA_AXES: Tuple[str, ...] = ("data", "fsdp")  # batch is sharded over both
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh geometry.  Any axis left at None is inferred.
+
+    At most one axis may be None; it absorbs ``device_count // product(rest)``.
+    With every axis None-free the product must equal the device count.
+    If all axes are concrete sizes of 1 except none, ``data`` defaults to None
+    (absorbs everything) — i.e. ``MeshSpec()`` is full data parallelism.
+    """
+
+    pipe: Optional[int] = 1
+    data: Optional[int] = None
+    fsdp: Optional[int] = 1
+    expert: Optional[int] = 1
+    seq: Optional[int] = 1
+    tensor: Optional[int] = 1
+
+    def sizes(self, device_count: int) -> Tuple[int, ...]:
+        raw = [getattr(self, name) for name in AXIS_ORDER]
+        free = [i for i, s in enumerate(raw) if s is None]
+        if len(free) > 1:
+            raise ValueError(f"At most one mesh axis may be None, got {free}")
+        known = math.prod(s for s in raw if s is not None)
+        if free:
+            if device_count % known != 0:
+                raise ValueError(
+                    f"{device_count} devices not divisible by fixed axes product {known}"
+                )
+            raw[free[0]] = device_count // known
+        elif known != device_count:
+            raise ValueError(
+                f"Mesh axes product {known} != device count {device_count}"
+            )
+        return tuple(raw)  # type: ignore[return-value]
+
+
+def create_mesh(
+    spec: Optional[MeshSpec] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` for ``spec`` over ``devices``.
+
+    Replaces Horovod's implicit world: the reference gets its communicator
+    from ``hvd.init()`` (``resnet_main.py:232``); here the mesh *is* the
+    communicator, and every collective in the train step is expressed against
+    its named axes.  ``jax.experimental.mesh_utils`` is used when available so
+    the device order respects physical TPU topology (ICI neighbours stay
+    mesh-adjacent).
+    """
+    spec = spec or MeshSpec()
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    sizes = spec.sizes(len(devices))
+    if all(d.platform == "tpu" for d in devices):
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(
+                sizes, devices=devices, allow_split_physical_axes=True
+            )
+        except Exception as exc:  # topology mismatch / API drift
+            logger.warning(
+                "mesh_utils.create_device_mesh failed (%s); falling back to "
+                "enumeration-order device layout — collectives may not be "
+                "ICI-adjacent",
+                exc,
+            )
+            dev_array = np.asarray(devices).reshape(sizes)
+    else:
+        # CPU/GPU fakes have no ICI topology; plain reshape is exact.
+        dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def world_size(mesh: Optional[Mesh] = None) -> int:
+    """Total device count — the reference's ``hvd.size()``."""
+    if mesh is None:
+        return jax.device_count()
+    return mesh.devices.size
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    """Number of data-parallel replicas (batch shards): data × fsdp."""
+    return int(np.prod([mesh.shape[a] for a in DATA_AXES]))
+
+
+def local_device_count() -> int:
+    """Devices attached to this host — the reference's GPUs-per-node=4
+    (``aml_compute.py:108-109``)."""
+    return jax.local_device_count()

@@ -1,0 +1,167 @@
+"""Sharding rules: how arrays are laid out over the mesh.
+
+The reference shards *data* only: per-rank file shards
+(``data/tfrecords.py:139`` — ``dataset.shard(hvd.size(), hvd.rank())``) and
+``DistributedSampler`` (``imagenet_pytorch_horovod.py:250-254``), with params
+replicated by Horovod broadcast.  Here the same contract — batch split over
+the data axes, everything else governed by explicit rules — is expressed as
+``NamedSharding``s that XLA compiles into ICI/DCN collectives.
+
+Parameter sharding uses logical-axis rules in the flax tradition: a model
+annotates its params with logical names (e.g. ``("embed", "mlp")``) and a rule
+list maps logical names to mesh axes.  DP maps everything to ``None``
+(replicated); FSDP maps the largest axis to ``"fsdp"``; TP maps hidden axes to
+``"tensor"``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
+
+PyTree = Any
+
+
+def batch_sharding(mesh: Mesh, *, extra_axes: Tuple[Optional[str], ...] = ()) -> NamedSharding:
+    """Batch arrays: leading dim split over the data axes (data, fsdp).
+
+    ``extra_axes`` optionally shards trailing dims, e.g. ``("seq",)`` for
+    sequence-parallel token dims.
+    """
+    return NamedSharding(mesh, P(DATA_AXES, *extra_axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch: PyTree) -> PyTree:
+    """Place a host-local batch onto the mesh, split over the data axes.
+
+    Single-process: a plain ``device_put`` with the batch sharding.
+    Multi-host: each process holds its slice of the global batch and
+    ``jax.make_array_from_process_local_data`` assembles the global array —
+    the TPU-native analogue of the reference's per-rank ``dataset.shard``
+    (SURVEY.md §7 "Hard parts" (a)).
+    """
+    sharding = batch_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        batch,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Logical-axis parameter sharding (flax partitioning convention).
+# ---------------------------------------------------------------------------
+
+# rule sets: logical axis name -> mesh axis (or None = replicate)
+RULES_DP: Sequence[Tuple[str, Optional[str]]] = [
+    # Pure data parallelism: all params replicated (Horovod semantics).
+]
+
+RULES_FSDP: Sequence[Tuple[str, Optional[str]]] = [
+    # ZeRO-3-style: shard embeddings/MLP widest axes along fsdp.
+    ("embed", "fsdp"),
+    ("mlp", "fsdp"),
+    ("heads", "fsdp"),
+    ("conv_out", "fsdp"),
+]
+
+RULES_TP: Sequence[Tuple[str, Optional[str]]] = [
+    # Megatron-style: column-parallel then row-parallel projections.
+    ("mlp", "tensor"),
+    ("heads", "tensor"),
+    ("kv", "tensor"),
+    ("embed", "fsdp"),
+]
+
+
+def logical_to_spec(
+    logical_axes: Tuple[Optional[str], ...],
+    rules: Sequence[Tuple[str, Optional[str]]],
+    *,
+    mesh: Optional[Mesh] = None,
+    shape: Optional[Tuple[int, ...]] = None,
+) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec via rules.
+
+    First matching rule wins; a mesh axis is used at most once per spec
+    (XLA requirement); unmatched logical axes replicate.  When ``mesh`` and
+    ``shape`` are given, a mapping is dropped (replicate) if the dimension
+    size is not divisible by the mesh axis size — small params (biases, few
+    attention heads) must not fail to shard a whole model.
+    """
+    taken = set()
+    out = []
+    for i, name in enumerate(logical_axes):
+        mapped = None
+        if name is not None:
+            for logical, mesh_axis in rules:
+                if logical == name and mesh_axis is not None and mesh_axis not in taken:
+                    if (
+                        mesh is not None
+                        and shape is not None
+                        and shape[i] % mesh.shape[mesh_axis] != 0
+                    ):
+                        continue
+                    mapped = mesh_axis
+                    taken.add(mesh_axis)
+                    break
+        out.append(mapped)
+    return P(*out)
+
+
+def param_shardings(
+    mesh: Mesh,
+    params: PyTree,
+    rules: Sequence[Tuple[str, Optional[str]]] = RULES_DP,
+    logical_axes: Optional[PyTree] = None,
+) -> PyTree:
+    """NamedShardings for a parameter tree.
+
+    Without ``logical_axes`` (plain DP models like ResNet) every param is
+    replicated — the reference's broadcast-then-allreduce contract
+    (``imagenet_pytorch_horovod.py:401-409``).  With logical axes (transformer
+    models annotated via ``flax.linen.partitioning``) each leaf's axes map
+    through ``rules``.
+    """
+    if logical_axes is None:
+        return jax.tree_util.tree_map(lambda _: replicated(mesh), params)
+
+    def _to_sharding(axes, param):
+        if axes is None:
+            return replicated(mesh)
+        shape = getattr(param, "shape", None)
+        return NamedSharding(
+            mesh, logical_to_spec(tuple(axes), rules, mesh=mesh, shape=shape)
+        )
+
+    return jax.tree_util.tree_map(
+        _to_sharding,
+        logical_axes,
+        params,
+        is_leaf=lambda x: isinstance(x, tuple) or x is None,
+    )
+
+
+def model_logical_axes(model, rng, *example_args, **example_kwargs) -> PyTree:
+    """Extract the logical-axis tree from a flax model's partitioning metadata.
+
+    Returns a pytree matching ``params`` whose leaves are tuples of logical
+    axis names (flax ``PartitionSpec``s) or None for unannotated params —
+    the ``logical_axes`` input to ``param_shardings``.
+    """
+    import flax.linen as nn
+    import jax as _jax
+
+    variables = _jax.eval_shape(lambda: model.init(rng, *example_args, **example_kwargs))
+    specs = nn.get_partition_spec(variables)
+    return specs["params"]

@@ -1,0 +1,20 @@
+"""Training core: train state, LR schedules, jitted step functions, loops."""
+
+from distributeddeeplearning_tpu.train.schedule import (
+    goyal_lr_schedule,
+    scale_base_lr,
+)
+from distributeddeeplearning_tpu.train.state import TrainState, create_train_state
+from distributeddeeplearning_tpu.train.step import (
+    build_eval_step,
+    build_train_step,
+)
+
+__all__ = [
+    "goyal_lr_schedule",
+    "scale_base_lr",
+    "TrainState",
+    "create_train_state",
+    "build_train_step",
+    "build_eval_step",
+]

@@ -1,0 +1,64 @@
+"""Learning-rate recipes.
+
+The accuracy-critical recipe from the reference (Goyal et al. 1706.02677,
+"Accurate, Large Minibatch SGD"), which BASELINE.md pins as the definition of
+"identical top-1":
+
+- base LR scaled linearly by world size: ``lr = base_lr × world_size``
+  (``imagenet_pytorch_horovod.py:296-302``, ``resnet_main.py:42``)
+- 5-epoch linear warmup from ``base_lr`` up to the scaled LR
+  (``imagenet_pytorch_horovod.py:263-289``)
+- step decay ÷10 at epochs 30/60/80
+  (``imagenet_pytorch_horovod.py:279-289``; vestigial TF variant
+  ``resnet_run_loop.py:39-62``)
+
+Expressed as pure step→lr functions (optax schedules) so they live inside the
+jitted update — no per-batch host-side ``adjust_learning_rate`` mutation.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import optax
+
+
+def scale_base_lr(base_lr: float, world_size: int) -> float:
+    """Linear LR scaling (Goyal §2.1): lr = base_lr × number of replicas."""
+    return base_lr * world_size
+
+
+def goyal_lr_schedule(
+    base_lr: float,
+    world_size: int,
+    steps_per_epoch: int,
+    *,
+    warmup_epochs: int = 5,
+    decay_epochs: Sequence[int] = (30, 60, 80),
+    decay_factor: float = 0.1,
+) -> optax.Schedule:
+    """The full reference schedule as one optax schedule.
+
+    Warmup ramps linearly from ``base_lr`` (not zero — matching the
+    reference's ``lr_adj = 1/size × (epoch×(size-1)/warmup + 1)`` shape at
+    ``imagenet_pytorch_horovod.py:276-278``, which starts at base_lr and ends
+    at base_lr×size) and then decays ÷10 at the milestone epochs.
+    """
+    peak = scale_base_lr(base_lr, world_size)
+    warmup_steps = warmup_epochs * steps_per_epoch
+
+    warmup = optax.linear_schedule(
+        init_value=base_lr,
+        end_value=peak,
+        transition_steps=max(warmup_steps, 1),
+    )
+    plateaus = [
+        optax.constant_schedule(peak * decay_factor**i)
+        for i in range(len(decay_epochs) + 1)
+    ]
+    boundaries = [warmup_steps] + [e * steps_per_epoch for e in decay_epochs]
+    return optax.join_schedules([warmup] + plateaus, boundaries)
+
+
+def constant_schedule(lr: float) -> optax.Schedule:
+    return optax.constant_schedule(lr)

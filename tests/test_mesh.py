@@ -1,0 +1,56 @@
+"""Mesh construction and geometry inference on the virtual 8-device pod."""
+
+import jax
+import pytest
+
+from distributeddeeplearning_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    MeshSpec,
+    create_mesh,
+    data_parallel_size,
+    world_size,
+)
+
+
+def test_default_spec_is_full_data_parallel():
+    mesh = create_mesh()
+    assert mesh.shape["data"] == 8
+    assert all(mesh.shape[a] == 1 for a in AXIS_ORDER if a != "data")
+
+
+def test_world_size_matches_devices():
+    mesh = create_mesh()
+    assert world_size(mesh) == 8 == jax.device_count()
+
+
+def test_explicit_axes():
+    mesh = create_mesh(MeshSpec(data=2, tensor=4))
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["tensor"] == 4
+    assert data_parallel_size(mesh) == 2
+
+
+def test_inferred_axis_absorbs_remainder():
+    mesh = create_mesh(MeshSpec(tensor=2))  # data=None absorbs 4
+    assert mesh.shape["data"] == 4
+    assert mesh.shape["tensor"] == 2
+
+
+def test_fsdp_counts_as_data_parallel():
+    mesh = create_mesh(MeshSpec(data=2, fsdp=4))
+    assert data_parallel_size(mesh) == 8
+
+
+def test_mismatched_product_raises():
+    with pytest.raises(ValueError):
+        create_mesh(MeshSpec(data=3, tensor=4))
+
+
+def test_two_free_axes_raise():
+    with pytest.raises(ValueError):
+        MeshSpec(data=None, fsdp=None).sizes(8)
+
+
+def test_subset_of_devices():
+    mesh = create_mesh(devices=jax.devices()[:4])
+    assert world_size(mesh) == 4

@@ -1,0 +1,103 @@
+"""Model zoo: shapes, registry, parameter-count parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.models import available_models, get_model
+
+
+def _param_count(model, shape, **init_kwargs):
+    v = model.init(jax.random.key(0), jnp.zeros(shape), train=False, **init_kwargs)
+    return sum(x.size for x in jax.tree_util.tree_leaves(v["params"]))
+
+
+def test_registry_has_reference_models():
+    names = available_models()
+    # resnet_model.py:292-306 depths + tf_cnn_benchmarks inception + BERT config
+    for expected in ["resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+                     "resnet200", "inceptionv3", "bert-base"]:
+        assert expected in names
+
+
+def test_unknown_model_raises():
+    with pytest.raises(ValueError, match="Unknown model"):
+        get_model("alexnet9000")
+
+
+@pytest.mark.parametrize("depth", [18, 34, 50])
+def test_resnet_output_shape(depth):
+    model = get_model(f"resnet{depth}", num_classes=13, dtype=jnp.float32)
+    v = model.init(jax.random.key(0), jnp.zeros((2, 64, 64, 3)), train=False)
+    out = model.apply(v, jnp.zeros((2, 64, 64, 3)), train=False)
+    assert out.shape == (2, 13)
+    assert out.dtype == jnp.float32
+
+
+def test_resnet50_param_count_parity():
+    """torchvision resnet50 has 25.557M params at 1000 classes; ours at 1001
+    (TF convention, defaults.py:11) must land within a whisker."""
+    model = get_model("resnet50", num_classes=1001, dtype=jnp.float32)
+    n = _param_count(model, (1, 224, 224, 3))
+    assert 25.4e6 < n < 25.8e6
+
+
+def test_resnet18_param_count_parity():
+    model = get_model("resnet18", num_classes=1000, dtype=jnp.float32)
+    n = _param_count(model, (1, 64, 64, 3))
+    assert 11.1e6 < n < 11.9e6  # torchvision: 11.69M
+
+
+def test_resnet_bf16_activations_fp32_params():
+    model = get_model("resnet18", num_classes=5)  # default dtype bf16
+    v = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    for leaf in jax.tree_util.tree_leaves(v["params"]):
+        assert leaf.dtype == jnp.float32
+    out = model.apply(v, jnp.zeros((1, 32, 32, 3)), train=False)
+    assert out.dtype == jnp.float32  # logits cast back for stable loss
+
+
+def test_bert_forward_and_mask():
+    model = get_model(
+        "bert-base", num_layers=2, hidden_size=32, num_heads=2,
+        intermediate_size=64, vocab_size=100, num_classes=3,
+        dropout_rate=0.0, dtype=jnp.float32,
+    )
+    ids = np.random.default_rng(0).integers(0, 100, (2, 10)).astype(np.int32)
+    v = model.init(jax.random.key(0), ids, train=False)
+    out = model.apply(v, ids, train=False)
+    assert out.shape == (2, 3)
+    mask = np.ones((2, 10), np.int32)
+    mask[:, 5:] = 0
+    masked = model.apply(v, ids, train=False, attention_mask=mask)
+    assert masked.shape == (2, 3)
+    assert not np.allclose(np.asarray(out), np.asarray(masked))
+
+
+def test_bert_params_carry_logical_axes():
+    """TP/FSDP sharding relies on flax logical axis metadata being present."""
+    import flax
+
+    model = get_model(
+        "bert-base", num_layers=1, hidden_size=32, num_heads=2,
+        intermediate_size=64, vocab_size=100, dtype=jnp.float32,
+    )
+    ids = np.zeros((1, 8), np.int32)
+    v = model.init(jax.random.key(0), ids, train=False)
+    specs = flax.linen.get_partition_spec(v["params"])
+    flat = jax.tree_util.tree_leaves_with_path(
+        specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)
+    )
+    named = [s for _, s in flat if any(a is not None for a in s)]
+    assert named, "expected logical axis annotations on BERT params"
+    all_names = {a for _, s in flat for a in s if a is not None}
+    assert {"embed", "mlp", "heads", "kv", "vocab"} <= all_names
+
+
+@pytest.mark.slow
+def test_inception_v3_shape():
+    model = get_model("inceptionv3", num_classes=7, dtype=jnp.float32)
+    v = model.init(jax.random.key(0), jnp.zeros((1, 299, 299, 3)), train=False)
+    out = model.apply(v, jnp.zeros((1, 299, 299, 3)), train=False)
+    assert out.shape == (1, 7)

@@ -1,0 +1,56 @@
+"""Goyal LR recipe — the accuracy-critical constants from BASELINE.md."""
+
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.train.schedule import (
+    goyal_lr_schedule,
+    scale_base_lr,
+)
+
+BASE_LR = 0.0125  # imagenet_pytorch_horovod.py:296-302
+SPE = 100  # steps per epoch
+
+
+def test_linear_scaling():
+    assert scale_base_lr(BASE_LR, 32) == pytest.approx(0.4)
+
+
+def test_warmup_starts_at_base_lr():
+    sched = goyal_lr_schedule(BASE_LR, 8, SPE)
+    assert float(sched(0)) == pytest.approx(BASE_LR)
+
+
+def test_warmup_reaches_peak_at_5_epochs():
+    sched = goyal_lr_schedule(BASE_LR, 8, SPE)
+    assert float(sched(5 * SPE)) == pytest.approx(BASE_LR * 8)
+
+
+def test_warmup_is_monotonic():
+    sched = goyal_lr_schedule(BASE_LR, 8, SPE)
+    lrs = [float(sched(s)) for s in range(0, 5 * SPE, 50)]
+    assert all(b >= a for a, b in zip(lrs, lrs[1:]))
+
+
+def test_step_decay_milestones():
+    sched = goyal_lr_schedule(BASE_LR, 8, SPE)
+    peak = BASE_LR * 8
+    assert float(sched(29 * SPE)) == pytest.approx(peak)
+    assert float(sched(31 * SPE)) == pytest.approx(peak * 0.1)
+    assert float(sched(61 * SPE)) == pytest.approx(peak * 0.01)
+    assert float(sched(81 * SPE)) == pytest.approx(peak * 0.001)
+    # constant tail
+    assert float(sched(200 * SPE)) == pytest.approx(peak * 0.001)
+
+
+def test_single_replica_has_no_warmup_ramp():
+    sched = goyal_lr_schedule(BASE_LR, 1, SPE)
+    assert float(sched(0)) == pytest.approx(BASE_LR)
+    assert float(sched(3 * SPE)) == pytest.approx(BASE_LR)
+
+
+def test_custom_milestones():
+    sched = goyal_lr_schedule(BASE_LR, 4, SPE, decay_epochs=(10, 20), decay_factor=0.5)
+    peak = BASE_LR * 4
+    assert float(sched(15 * SPE)) == pytest.approx(peak * 0.5)
+    assert float(sched(25 * SPE)) == pytest.approx(peak * 0.25)

@@ -1,0 +1,224 @@
+"""DP train/eval step semantics on the virtual 8-device mesh.
+
+The key correctness property (SURVEY.md §2 "Parallelism strategies"): 8-way
+data parallelism must compute the SAME update as single-device training on
+the full global batch — that is what Horovod's averaged allreduce guarantees
+in the reference, and what XLA's sharding propagation must reproduce here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributeddeeplearning_tpu.data.synthetic import synthetic_batch
+from distributeddeeplearning_tpu.models import get_model
+from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh, shard_batch
+from distributeddeeplearning_tpu.train.schedule import goyal_lr_schedule
+from distributeddeeplearning_tpu.train.state import create_train_state, sgd_momentum
+from distributeddeeplearning_tpu.train.step import (
+    build_eval_step,
+    build_train_step,
+    cross_entropy_loss,
+    topk_correct,
+)
+
+IMG = (32, 32, 3)
+NCLS = 11
+
+
+def _make_state(lr=0.1, seed=0):
+    model = get_model("resnet18", num_classes=NCLS, dtype=jnp.float32)
+    tx = sgd_momentum(optax.constant_schedule(lr), weight_decay=5e-5)
+    return create_train_state(
+        jax.random.key(seed), model, (8, *IMG), tx
+    )
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return create_mesh(MeshSpec())
+
+
+def test_loss_decreases_on_fixed_batch(mesh8):
+    state = _make_state()
+    step = build_train_step(mesh8, state, compute_dtype=jnp.float32)
+    batch = shard_batch(mesh8, synthetic_batch(16, IMG, NCLS))
+    state, first = step(state, batch)
+    for _ in range(5):
+        state, metrics = step(state, batch)
+    assert float(metrics["loss"]) < float(first["loss"])
+
+
+def test_dp_equals_single_device():
+    """The allreduce contract: same batch, 8-way sharded vs 1 device."""
+    batch_np = synthetic_batch(16, IMG, NCLS, seed=3)
+
+    mesh8 = create_mesh(MeshSpec())
+    state8 = _make_state(seed=1)
+    step8 = build_train_step(mesh8, state8, compute_dtype=jnp.float32)
+    _, m8 = step8(state8, shard_batch(mesh8, batch_np))
+
+    mesh1 = create_mesh(devices=jax.devices()[:1])
+    state1 = _make_state(seed=1)
+    step1 = build_train_step(mesh1, state1, compute_dtype=jnp.float32)
+    _, m1 = step1(state1, shard_batch(mesh1, batch_np))
+
+    np.testing.assert_allclose(float(m8["loss"]), float(m1["loss"]), rtol=1e-4)
+    np.testing.assert_allclose(float(m8["top5"]), float(m1["top5"]), rtol=1e-5)
+
+
+def test_metrics_shape_and_keys(mesh8):
+    state = _make_state()
+    sched = goyal_lr_schedule(0.0125, 8, 10)
+    step = build_train_step(mesh8, state, schedule=sched, compute_dtype=jnp.float32)
+    batch = shard_batch(mesh8, synthetic_batch(16, IMG, NCLS))
+    _, metrics = step(state, batch)
+    assert set(metrics) == {"loss", "top1", "top5", "lr"}
+    for v in metrics.values():
+        assert v.shape == ()
+        assert jnp.isfinite(v)
+
+
+def test_state_step_increments(mesh8):
+    state = _make_state()
+    step = build_train_step(mesh8, state, compute_dtype=jnp.float32)
+    batch = shard_batch(mesh8, synthetic_batch(16, IMG, NCLS))
+    new_state, _ = step(state, batch)
+    assert int(new_state.step) == 1
+
+
+def test_batch_stats_update(mesh8):
+    state = _make_state()
+    step = build_train_step(mesh8, state, compute_dtype=jnp.float32)
+    batch = shard_batch(mesh8, synthetic_batch(16, IMG, NCLS))
+    old = jax.tree_util.tree_leaves(state.batch_stats)[0].copy()
+    new_state, _ = step(state, batch)
+    new = jax.tree_util.tree_leaves(new_state.batch_stats)[0]
+    assert not np.allclose(np.asarray(old), np.asarray(new))
+
+
+def test_eval_step_does_not_mutate(mesh8):
+    state = _make_state()
+    ev = build_eval_step(mesh8, state, compute_dtype=jnp.float32)
+    batch = shard_batch(mesh8, synthetic_batch(16, IMG, NCLS))
+    metrics = ev(state, batch)
+    assert set(metrics) == {"loss", "top1", "top5"}
+
+
+def test_cross_entropy_matches_reference_formula():
+    logits = jnp.array([[2.0, 0.0, -1.0], [0.0, 3.0, 0.5]])
+    labels = jnp.array([0, 1])
+    expected = -np.mean(
+        [
+            np.log(np.exp(2.0) / np.exp([2.0, 0.0, -1.0]).sum()),
+            np.log(np.exp(3.0) / np.exp([0.0, 3.0, 0.5]).sum()),
+        ]
+    )
+    np.testing.assert_allclose(float(cross_entropy_loss(logits, labels)), expected, rtol=1e-6)
+
+
+def test_topk_accuracy():
+    logits = jnp.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]])
+    labels = jnp.array([1, 2])
+    assert float(topk_correct(logits, labels, 1)) == pytest.approx(0.5)
+    assert float(topk_correct(logits, labels, 3)) == pytest.approx(1.0)
+
+
+def test_bert_with_dropout_trains(mesh8):
+    """Dropout RNG plumbing: the default BERT config (dropout 0.1) must train."""
+    from distributeddeeplearning_tpu.models import get_model as gm
+
+    model = gm(
+        "bert-base", num_layers=1, hidden_size=32, num_heads=2,
+        intermediate_size=64, vocab_size=50, num_classes=3,
+        max_position_embeddings=16, dtype=jnp.float32,  # dropout_rate=0.1 default
+    )
+    tx = sgd_momentum(optax.constant_schedule(0.01))
+    state = create_train_state(
+        jax.random.key(0), model, (2, 8), tx, input_dtype=jnp.int32
+    )
+    step = build_train_step(mesh8, state, compute_dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    batch = shard_batch(
+        mesh8,
+        {
+            "input": rng.integers(0, 50, (16, 8)).astype(np.int32),
+            "label": rng.integers(0, 3, (16,)).astype(np.int32),
+        },
+    )
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_fsdp_opt_state_mirrors_param_sharding():
+    """ZeRO contract: momentum buffers shard exactly like their params."""
+    from distributeddeeplearning_tpu.models import get_model as gm
+    from distributeddeeplearning_tpu.parallel.sharding import (
+        RULES_FSDP,
+        model_logical_axes,
+    )
+
+    mesh = create_mesh(MeshSpec(fsdp=8))
+    model = gm(
+        "bert-base", num_layers=1, hidden_size=32, num_heads=2,
+        intermediate_size=64, vocab_size=50, num_classes=3,
+        max_position_embeddings=16, dropout_rate=0.0, dtype=jnp.float32,
+    )
+    axes = model_logical_axes(
+        model, jax.random.key(0), np.zeros((2, 8), np.int32), train=False
+    )
+    tx = sgd_momentum(optax.constant_schedule(0.01))
+    state = create_train_state(
+        jax.random.key(0), model, (2, 8), tx, input_dtype=jnp.int32
+    )
+    step = build_train_step(
+        mesh, state, compute_dtype=jnp.float32,
+        rules=RULES_FSDP, logical_axes=axes,
+    )
+    rng = np.random.default_rng(0)
+    batch = shard_batch(
+        mesh,
+        {
+            "input": rng.integers(0, 50, (16, 8)).astype(np.int32),
+            "label": rng.integers(0, 3, (16,)).astype(np.int32),
+        },
+    )
+    state, _ = step(state, batch)
+    kernel = state.params["layer0"]["mlp_in"]["kernel"]
+    assert "fsdp" in tuple(kernel.sharding.spec)
+    # momentum trace for the same param must carry the same sharding
+    momentum_leaves = [
+        leaf
+        for sub in jax.tree_util.tree_leaves(
+            state.opt_state, is_leaf=lambda x: hasattr(x, "sharding")
+        )
+        if hasattr(sub, "sharding")
+        for leaf in [sub]
+        if leaf.shape == kernel.shape
+    ]
+    assert momentum_leaves
+    assert any(
+        leaf.sharding.is_equivalent_to(kernel.sharding, leaf.ndim)
+        for leaf in momentum_leaves
+    )
+
+
+def test_label_smoothing_changes_loss(mesh8):
+    # The state fed to a step must share the model/tx objects of the
+    # state_example the step was built from (static pytree fields).
+    model = get_model("resnet18", num_classes=NCLS, dtype=jnp.float32)
+    tx = sgd_momentum(optax.constant_schedule(0.1))
+
+    def mk():
+        return create_train_state(jax.random.key(0), model, (8, *IMG), tx)
+
+    batch = shard_batch(mesh8, synthetic_batch(16, IMG, NCLS))
+    plain = build_train_step(mesh8, mk(), compute_dtype=jnp.float32)
+    smooth = build_train_step(
+        mesh8, mk(), compute_dtype=jnp.float32, label_smoothing=0.1
+    )
+    _, m_plain = plain(mk(), batch)
+    _, m_smooth = smooth(mk(), batch)
+    assert float(m_plain["loss"]) != float(m_smooth["loss"])
