@@ -1,0 +1,93 @@
+"""Raw-JPEG directory input pipeline (ImageFolder-style).
+
+Parity with both reference raw-image loaders:
+- TF ``data/images.py:15-209`` (16f) — directory-walk with nounid→label
+  lookup, shard/shuffle/interleave pipeline.  Its eval path is broken (the
+  ``parallel_interleave`` is mis-indented into ``if is_training:`` — SURVEY.md
+  §2 notes); here train and eval share one correct dataflow.
+- PyTorch ``ImageFolder`` + ``DistributedSampler``
+  (``imagenet_pytorch_horovod.py:331-369``).
+
+Labels: 1-based by sorted wnid (background=0, NUM_CLASSES=1001), identical to
+the TFRecord converter, so raw-image and tfrecord training agree on classes.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from distributeddeeplearning_tpu.data.preprocessing import (
+    DEFAULT_IMAGE_SIZE,
+    preprocess_image,
+)
+
+
+def list_images(data_dir: str) -> Tuple[List[str], List[int], Dict[str, int]]:
+    """Walk ``data_dir/<wnid>/*`` → (paths, 1-based labels, wnid→label)."""
+    wnids = sorted(d.name for d in Path(data_dir).iterdir() if d.is_dir())
+    wnid_to_label = {w: i + 1 for i, w in enumerate(wnids)}
+    paths: List[str] = []
+    labels: List[int] = []
+    for wnid in wnids:
+        for img in sorted(Path(data_dir, wnid).glob("*")):
+            if img.suffix.lower() in (".jpeg", ".jpg", ".png"):
+                paths.append(str(img))
+                labels.append(wnid_to_label[wnid])
+    return paths, labels, wnid_to_label
+
+
+def build_dataset(
+    data_dir: str,
+    is_training: bool,
+    batch_size: int,
+    *,
+    image_size: int = DEFAULT_IMAGE_SIZE,
+    shard_index: int = 0,
+    shard_count: int = 1,
+    shuffle_buffer: int = 10000,
+    repeat: bool = True,
+    seed: Optional[int] = None,
+    drop_remainder: bool = True,
+):
+    """tf.data pipeline over raw image files, host-sharded by FILE (each host
+    reads a disjoint slice — the ``DistributedSampler`` contract)."""
+    import tensorflow as tf
+
+    paths, labels, _ = list_images(data_dir)
+    if not paths:
+        raise FileNotFoundError(f"no class-dir images under {data_dir}")
+    ds = tf.data.Dataset.from_tensor_slices((paths, labels))
+    if shard_count > 1:
+        ds = ds.shard(shard_count, shard_index)
+    if is_training:
+        ds = ds.shuffle(min(len(paths), shuffle_buffer), seed=seed)
+    if repeat:
+        ds = ds.repeat()
+
+    def load(path, label):
+        image = preprocess_image(tf.io.read_file(path), is_training, image_size)
+        return image, tf.cast(label, tf.int32)
+
+    ds = ds.map(load, num_parallel_calls=tf.data.AUTOTUNE)
+    ds = ds.batch(batch_size, drop_remainder=drop_remainder)
+    return ds.prefetch(tf.data.AUTOTUNE)
+
+
+def input_fn(
+    data_dir: str,
+    is_training: bool,
+    batch_size: int,
+    **kwargs,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Numpy-batch iterator, host-shard geometry defaulted from JAX topology."""
+    import jax
+
+    kwargs.setdefault("shard_count", jax.process_count())
+    kwargs.setdefault("shard_index", jax.process_index())
+    ds = build_dataset(data_dir, is_training, batch_size, **kwargs)
+    for image, label in ds.as_numpy_iterator():
+        yield {"image": image, "label": label}

@@ -1,0 +1,122 @@
+"""ImageNet preprocessing — recipe parity with the reference, TPU-shaped.
+
+Reference: ``TensorFlow_imagenet/src/imagenet_preprocessing.py:51-222`` (16g):
+train = decode JPEG → random resized crop → random horizontal flip; eval =
+aspect-preserving resize to 256-short-side → 224 central crop; both subtract
+the channel means [123.68, 116.78, 103.94] (no std division).  The recipe is
+preserved exactly — it is part of the "identical top-1" contract — but the
+implementation is tf.data ops running on the TPU-VM host CPUs feeding JAX,
+emitting NHWC float32 (the reference transposes to NCHW for cuDNN at
+``imagenet_preprocessing.py:214-219``; on TPU, NHWC is the fast layout so no
+transpose exists).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+# Channel means, RGB order — imagenet_preprocessing.py:30-33.
+CHANNEL_MEANS = (123.68, 116.78, 103.94)
+DEFAULT_IMAGE_SIZE = 224
+RESIZE_MIN = 256  # eval short-side target, _aspect_preserving_resize
+
+
+def _tf():
+    import tensorflow as tf
+
+    return tf
+
+
+def decode_and_random_crop(image_bytes, image_size: int):
+    """Train-path decode: sampled distorted bounding box crop (the standard
+    Inception-style crop the reference's train path uses via
+    ``tf.image.sample_distorted_bounding_box``), resized to the target."""
+    tf = _tf()
+    shape = tf.io.extract_jpeg_shape(image_bytes)
+    bbox = tf.constant([0.0, 0.0, 1.0, 1.0], shape=[1, 1, 4])
+    begin, size, _ = tf.image.sample_distorted_bounding_box(
+        shape,
+        bounding_boxes=bbox,
+        min_object_covered=0.1,
+        aspect_ratio_range=(3 / 4, 4 / 3),
+        area_range=(0.08, 1.0),
+        max_attempts=10,
+        use_image_if_no_bounding_boxes=True,
+    )
+    offset_y, offset_x, _ = tf.unstack(begin)
+    target_h, target_w, _ = tf.unstack(size)
+    image = tf.image.decode_and_crop_jpeg(
+        image_bytes,
+        tf.stack([offset_y, offset_x, target_h, target_w]),
+        channels=3,
+    )
+    return tf.image.resize(image, [image_size, image_size], method="bilinear")
+
+
+def decode_and_center_crop(image_bytes, image_size: int):
+    """Eval path: aspect-preserving resize (short side → RESIZE_MIN scaled
+    proportionally to the crop) then central crop — parity with
+    ``_aspect_preserving_resize`` + ``_central_crop``
+    (``imagenet_preprocessing.py:51-105``)."""
+    tf = _tf()
+    shape = tf.io.extract_jpeg_shape(image_bytes)
+    h, w = shape[0], shape[1]
+    # crop fraction image_size/RESIZE_MIN of the short side (224/256 = 87.5%)
+    crop_size = tf.cast(
+        tf.cast(tf.minimum(h, w), tf.float32) * (image_size / RESIZE_MIN),
+        tf.int32,
+    )
+    offset_y = (h - crop_size) // 2
+    offset_x = (w - crop_size) // 2
+    image = tf.image.decode_and_crop_jpeg(
+        image_bytes,
+        tf.stack([offset_y, offset_x, crop_size, crop_size]),
+        channels=3,
+    )
+    return tf.image.resize(image, [image_size, image_size], method="bilinear")
+
+
+def mean_image_subtraction(image):
+    """Channel-mean subtraction, no std scaling —
+    ``_mean_image_subtraction`` (``imagenet_preprocessing.py:108-136``)."""
+    tf = _tf()
+    return image - tf.constant(CHANNEL_MEANS, shape=[1, 1, 3], dtype=image.dtype)
+
+
+def preprocess_image(
+    image_bytes,
+    is_training: bool,
+    image_size: int = DEFAULT_IMAGE_SIZE,
+):
+    """JPEG bytes → NHWC float32, recipe-parity with ``preprocess_image``
+    (``imagenet_preprocessing.py:180-222``)."""
+    tf = _tf()
+    if is_training:
+        image = decode_and_random_crop(image_bytes, image_size)
+        image = tf.image.random_flip_left_right(image)
+    else:
+        image = decode_and_center_crop(image_bytes, image_size)
+    image = tf.cast(image, tf.float32)
+    image = mean_image_subtraction(image)
+    image.set_shape([image_size, image_size, 3])
+    return image
+
+
+# --- pure-numpy variants for tests and non-TF callers ---
+
+
+def normalize_np(image: np.ndarray) -> np.ndarray:
+    return image.astype(np.float32) - np.asarray(CHANNEL_MEANS, np.float32)
+
+
+def central_crop_np(image: np.ndarray, image_size: int) -> np.ndarray:
+    h, w = image.shape[:2]
+    crop = int(min(h, w) * image_size / RESIZE_MIN)
+    y, x = (h - crop) // 2, (w - crop) // 2
+    cropped = image[y : y + crop, x : x + crop]
+    # nearest-neighbour resize (test fidelity only)
+    ys = (np.arange(image_size) * crop / image_size).astype(int)
+    xs = (np.arange(image_size) * crop / image_size).astype(int)
+    return cropped[ys][:, xs]

@@ -1,0 +1,146 @@
+"""Sharded TFRecord input pipeline feeding JAX — the flagship real-data path.
+
+Reader parity with ``TensorFlow_imagenet/src/data/tfrecords.py:11-217`` (16e):
+- shard layout ``train-%05d-of-01014`` / ``validation-%05d-of-00128``
+  (converter ``convert_imagenet_to_tf_records.py:507-513``)
+- existence check of every expected shard before training (``:130-132``)
+- **per-rank file sharding** — the reference shards the file list by
+  ``hvd.size()/hvd.rank()`` (``:139``); here it is per-HOST:
+  ``shard(jax.process_count(), jax.process_index())``, because on TPU the
+  unit of data loading is the host process feeding its local chips, and
+  ``parallel.shard_batch`` assembles the global array from per-host slices.
+- parallel interleave → shuffle → repeat → map(parse+preprocess) → batch →
+  prefetch, the same dataflow shape (``:100-166``), with AUTOTUNE instead of
+  the reference's hand-pinned cycle lengths.
+
+The Example schema matches the reference converter exactly
+(``convert_imagenet_to_tf_records.py:111-146``) so data produced for the
+reference trains here unchanged: ``image/encoded`` (JPEG bytes),
+``image/class/label`` (1-based, 1..1000, background=0 convention →
+NUM_CLASSES=1001).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from distributeddeeplearning_tpu.data.preprocessing import (
+    DEFAULT_IMAGE_SIZE,
+    preprocess_image,
+)
+
+DEFAULT_TRAIN_SHARDS = 1014  # convert_imagenet_to_tf_records.py:512
+DEFAULT_VALIDATION_SHARDS = 128  # :513
+SHUFFLE_BUFFER = 10000
+NUM_IMAGES = {"train": 1281167, "validation": 50000}  # defaults.py:13-15
+
+
+def shard_filenames(
+    data_dir: str,
+    is_training: bool,
+    num_shards: Optional[int] = None,
+) -> list:
+    """Expected shard paths; existence-checked like ``get_filenames``
+    (``data/tfrecords.py:124-140``)."""
+    if num_shards is None:
+        num_shards = DEFAULT_TRAIN_SHARDS if is_training else DEFAULT_VALIDATION_SHARDS
+    prefix = "train" if is_training else "validation"
+    names = [
+        os.path.join(data_dir, f"{prefix}-{i:05d}-of-{num_shards:05d}")
+        for i in range(num_shards)
+    ]
+    missing = [n for n in names if not os.path.exists(n)]
+    if missing:
+        raise FileNotFoundError(
+            f"{len(missing)}/{num_shards} expected TFRecord shards missing, "
+            f"first: {missing[0]}"
+        )
+    return names
+
+
+def parse_record(serialized, is_training: bool, image_size: int):
+    """Example proto → (image, label); schema parity with ``parse_record``
+    (``data/tfrecords.py:169-217``)."""
+    import tensorflow as tf
+
+    features = tf.io.parse_single_example(
+        serialized,
+        {
+            "image/encoded": tf.io.FixedLenFeature([], tf.string, ""),
+            "image/class/label": tf.io.FixedLenFeature([], tf.int64, -1),
+        },
+    )
+    image = preprocess_image(
+        features["image/encoded"], is_training, image_size
+    )
+    label = tf.cast(features["image/class/label"], tf.int32)
+    return image, label
+
+
+def build_dataset(
+    data_dir: str,
+    is_training: bool,
+    batch_size: int,
+    *,
+    image_size: int = DEFAULT_IMAGE_SIZE,
+    num_shards: Optional[int] = None,
+    shard_index: int = 0,
+    shard_count: int = 1,
+    shuffle_buffer: int = SHUFFLE_BUFFER,
+    repeat: bool = True,
+    seed: Optional[int] = None,
+    drop_remainder: bool = True,
+):
+    """tf.data pipeline over the shard files, host-sharded.
+
+    ``batch_size`` is the PER-HOST batch (global // process_count); the
+    caller assembles global arrays with ``parallel.shard_batch``.
+    """
+    import tensorflow as tf
+
+    filenames = shard_filenames(data_dir, is_training, num_shards)
+    ds = tf.data.Dataset.from_tensor_slices(filenames)
+    if shard_count > 1:
+        ds = ds.shard(shard_count, shard_index)
+    if is_training:
+        ds = ds.shuffle(len(filenames), seed=seed, reshuffle_each_iteration=True)
+    ds = ds.interleave(
+        tf.data.TFRecordDataset,
+        cycle_length=tf.data.AUTOTUNE,
+        num_parallel_calls=tf.data.AUTOTUNE,
+        deterministic=not is_training,
+    )
+    if is_training:
+        ds = ds.shuffle(shuffle_buffer, seed=seed)
+    if repeat:
+        ds = ds.repeat()
+    ds = ds.map(
+        lambda rec: parse_record(rec, is_training, image_size),
+        num_parallel_calls=tf.data.AUTOTUNE,
+    )
+    ds = ds.batch(batch_size, drop_remainder=drop_remainder)
+    return ds.prefetch(tf.data.AUTOTUNE)
+
+
+def input_fn(
+    data_dir: str,
+    is_training: bool,
+    batch_size: int,
+    **kwargs,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Numpy-batch iterator for the training loop: {'image', 'label'} dicts,
+    per-host slices ready for ``parallel.shard_batch``.
+
+    Defaults the host shard geometry from the JAX process topology — the
+    TPU-native ``dataset.shard(hvd.size(), hvd.rank())``.
+    """
+    import jax
+
+    kwargs.setdefault("shard_count", jax.process_count())
+    kwargs.setdefault("shard_index", jax.process_index())
+    ds = build_dataset(data_dir, is_training, batch_size, **kwargs)
+    for image, label in ds.as_numpy_iterator():
+        yield {"image": image, "label": label}

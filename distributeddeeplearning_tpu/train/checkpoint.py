@@ -1,0 +1,108 @@
+"""Sharded checkpoint / resume — done properly.
+
+The reference's three partial mechanisms (SURVEY.md §5 "Checkpoint / resume"):
+TF Estimator implicit rank-0 checkpoints (``resnet_main.py:140-158``), a buggy
+PyTorch rank-0 epoch save (``imagenet_pytorch_horovod.py:257-260`` — NameError
+off rank 0), and a full resume protocol stranded in dead code
+(``PyTorch_hvd/src/imagenet_pytorch_horovod.py:62-72,133-144``: scan
+checkpoint files backwards, broadcast resume epoch, load on rank 0, broadcast
+state).
+
+TPU-native replacement: orbax ``CheckpointManager`` writes the train-state
+pytree **sharded** — every host writes its own param shards in parallel (no
+rank-0 gather, no broadcast; the reference's whole protocol exists because
+Horovod has no sharded storage), and restore places shards directly onto the
+mesh from the target state's shardings.  ``latest_step()`` replaces the
+backwards file scan; multihost coordination is orbax's, keyed off
+``jax.process_index()``.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+logger = logging.getLogger("ddlt.checkpoint")
+
+PyTree = Any
+
+
+class Checkpointer:
+    """Epoch/step-granular sharded checkpointing of a ``TrainState``.
+
+    Only array fields travel (step, params, opt_state, batch_stats); static
+    fields (apply_fn, tx) are re-supplied by the restore template, which is
+    also the source of target shardings.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: int = 5,
+        save_interval_steps: int = 1,
+    ):
+        self.directory = Path(directory).absolute()
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                create=True,
+                enable_async_checkpointing=False,
+            ),
+        )
+
+    @staticmethod
+    def _arrays_of(state) -> PyTree:
+        return {
+            "step": state.step,
+            "params": state.params,
+            "opt_state": state.opt_state,
+            "batch_stats": state.batch_stats,
+        }
+
+    def save(self, step: int, state) -> bool:
+        """Save if the manager's policy wants this step. Returns True if saved."""
+        saved = self._mgr.save(
+            step, args=ocp.args.StandardSave(self._arrays_of(state))
+        )
+        if saved:
+            logger.info("checkpoint saved at step %d -> %s", step, self.directory)
+        return saved
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state_template):
+        """Restore the latest checkpoint INTO the template's shardings.
+
+        Returns (state, step); (template, None) when nothing to restore —
+        the deterministic-resume contract the vestigial reference code
+        approximated with hvd.broadcast of the resume epoch.
+        """
+        step = self.latest_step()
+        if step is None:
+            return state_template, None
+        abstract = jax.tree_util.tree_map(
+            ocp.utils.to_shape_dtype_struct, self._arrays_of(state_template)
+        )
+        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        state = state_template.replace(
+            step=restored["step"],
+            params=restored["params"],
+            opt_state=restored["opt_state"],
+            batch_stats=restored["batch_stats"],
+        )
+        logger.info("restored checkpoint step %d from %s", step, self.directory)
+        return state, step
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,13 @@
+"""Training workloads — the L5 layer (SURVEY.md §1).
+
+One module per reference workload family:
+- ``imagenet``   ↔ TF ``resnet_main.py`` (16c) + PyTorch
+  ``imagenet_pytorch_horovod.py`` (16l): full train/eval with synthetic,
+  raw-image, or TFRecord input
+- ``benchmark``  ↔ ``pytorch_synthetic_benchmark.py`` (16b) + the
+  tf_cnn_benchmarks role (16a): synthetic throughput measurement
+- ``experiment`` ↔ the blank experiment templates (16o/16p)
+
+Each exposes ``main(**flags)`` — the per-process entry the submit layer
+launches on every TPU host (the reference's per-MPI-rank script contract).
+"""

@@ -1,0 +1,174 @@
+"""ImageNet classification training — the flagship workload.
+
+Capability parity with BOTH reference trainers (they are the same recipe in
+two frameworks):
+- TF Estimator ResNet-50: ``TensorFlow_imagenet/src/resnet_main.py:37-312``
+- PyTorch Horovod ResNet-50: ``PyTorch_imagenet/src/imagenet_pytorch_horovod.py:50-446``
+
+Flags mirror the reference's (fire-parsed there, keyword args here): model
+depth, per-chip batch size (64, ``defaults.py:7``), epochs, base LR 0.0125
+with Goyal warmup/decay, momentum 0.9, weight decay 5e-5, synthetic/images/
+tfrecords input switch, checkpoint/resume, TensorBoard.
+
+TPU-native differences (by design, not omission):
+- one process per TPU host drives all local chips through the global-batch
+  jitted step; there is no per-GPU rank loop;
+- ``steps_per_epoch = NUM_IMAGES // global_batch`` — the reference's
+  ``total_batches // hvd.size()`` (``resnet_main.py:246-247``) with the
+  division done once;
+- eval runs on all chips (the reference restricts eval to rank 0,
+  ``resnet_main.py:293-307``, leaving N-1 GPUs idle).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from typing import Iterator, Optional
+
+logger = logging.getLogger("ddlt.workloads.imagenet")
+
+NUM_IMAGES = {"train": 1281167, "validation": 50000}  # defaults.py:13-15
+NUM_CLASSES = 1001  # defaults.py:11
+DEFAULT_BATCH_PER_CHIP = 64  # defaults.py:7
+BASE_LR = 0.0125  # imagenet_pytorch_horovod.py:296-302
+
+
+def _batches(
+    data_format: str,
+    data_path: Optional[str],
+    is_training: bool,
+    per_host_batch: int,
+    image_size: int,
+    num_classes: int,
+    seed: Optional[int],
+    synthetic_length: Optional[int] = None,
+) -> Iterator:
+    if data_format == "synthetic":
+        from distributeddeeplearning_tpu.data.synthetic import SyntheticDataset
+
+        ds = SyntheticDataset(
+            length=synthetic_length,
+            image_shape=(image_size, image_size, 3),
+            num_classes=num_classes,
+            seed=seed or 42,
+        )
+        it = ds.batches(per_host_batch)
+        return itertools.cycle(it) if is_training else it
+    if data_format == "tfrecords":
+        from distributeddeeplearning_tpu.data import tfrecords
+
+        return tfrecords.input_fn(
+            data_path, is_training, per_host_batch,
+            image_size=image_size, seed=seed, repeat=is_training,
+        )
+    if data_format == "images":
+        from distributeddeeplearning_tpu.data import images
+
+        return images.input_fn(
+            data_path, is_training, per_host_batch,
+            image_size=image_size, seed=seed, repeat=is_training,
+        )
+    raise ValueError(f"unknown data_format {data_format!r}")
+
+
+def main(
+    *,
+    model: str = "resnet50",
+    data_format: str = "synthetic",
+    training_data_path: Optional[str] = None,
+    validation_data_path: Optional[str] = None,
+    epochs: int = 90,
+    batch_size: int = DEFAULT_BATCH_PER_CHIP,  # per chip
+    base_lr: float = BASE_LR,
+    momentum: float = 0.9,  # imagenet_pytorch_horovod.py:42
+    weight_decay: float = 5e-5,  # imagenet_pytorch_horovod.py:43
+    warmup_epochs: int = 5,
+    label_smoothing: float = 0.0,
+    image_size: int = 224,
+    num_classes: int = NUM_CLASSES,
+    save_filepath: Optional[str] = None,  # resnet_main.py model_dir analogue
+    tensorboard_dir: Optional[str] = None,
+    resume: bool = True,
+    steps_per_epoch: Optional[int] = None,
+    train_images: Optional[int] = None,
+    seed: int = 42,
+    compute_dtype: str = "bfloat16",
+    distributed: Optional[bool] = None,
+):
+    """Train; returns (state, FitResult)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh, initialize
+    from distributeddeeplearning_tpu.train.loop import Trainer, TrainerConfig
+    from distributeddeeplearning_tpu.train.schedule import goyal_lr_schedule
+    from distributeddeeplearning_tpu.train.state import (
+        create_train_state,
+        sgd_momentum,
+    )
+    from distributeddeeplearning_tpu.train.step import (
+        build_eval_step,
+        build_train_step,
+    )
+
+    ctx = initialize(force=distributed)
+    mesh = create_mesh(MeshSpec())
+    world = mesh.devices.size
+    global_batch = batch_size * world
+    per_host_batch = global_batch // ctx.process_count
+
+    n_train = train_images or (
+        NUM_IMAGES["train"] if data_format != "synthetic" else 50_000
+    )
+    spe = steps_per_epoch or max(n_train // global_batch, 1)
+    dtype = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
+
+    if ctx.is_primary:
+        logger.info(
+            "training %s: %d chips, global batch %d, %d steps/epoch, %d epochs",
+            model, world, global_batch, spe, epochs,
+        )
+
+    net = get_model(model, num_classes=num_classes, dtype=dtype)
+    schedule = goyal_lr_schedule(
+        base_lr, world, spe, warmup_epochs=warmup_epochs
+    )
+    tx = sgd_momentum(schedule, momentum=momentum, weight_decay=weight_decay)
+    state = create_train_state(
+        jax.random.key(seed), net, (1, image_size, image_size, 3), tx
+    )
+    train_step = build_train_step(
+        mesh, state, schedule=schedule, label_smoothing=label_smoothing,
+        compute_dtype=dtype, rng=jax.random.key(seed + 1),
+    )
+    eval_step = build_eval_step(mesh, state, compute_dtype=dtype)
+
+    train_iter = _batches(
+        data_format, training_data_path, True, per_host_batch,
+        image_size, num_classes, seed, synthetic_length=n_train,
+    )
+    eval_factory = None
+    if validation_data_path or data_format == "synthetic":
+        def eval_factory():
+            return _batches(
+                data_format, validation_data_path, False, per_host_batch,
+                image_size, num_classes, seed,
+                synthetic_length=min(n_train, 4 * global_batch),
+            )
+
+    trainer = Trainer(
+        mesh,
+        train_step,
+        eval_step=eval_step,
+        config=TrainerConfig(
+            epochs=epochs,
+            steps_per_epoch=spe,
+            global_batch_size=global_batch,
+            checkpoint_dir=save_filepath,
+            tensorboard_dir=tensorboard_dir,
+            resume=resume,
+        ),
+    )
+    return trainer.fit(state, train_iter, eval_factory)

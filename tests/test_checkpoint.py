@@ -1,0 +1,108 @@
+"""Sharded checkpoint/resume semantics (the protocol the reference only had
+in dead code — PyTorch_hvd:62-72,133-144)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributeddeeplearning_tpu.data.synthetic import synthetic_batch
+from distributeddeeplearning_tpu.models import get_model
+from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh, shard_batch
+from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
+from distributeddeeplearning_tpu.train.state import create_train_state, sgd_momentum
+from distributeddeeplearning_tpu.train.step import build_train_step
+
+IMG = (24, 24, 3)
+NCLS = 7
+
+
+@pytest.fixture(scope="module")
+def setup():
+    mesh = create_mesh(MeshSpec())
+    model = get_model("resnet18", num_classes=NCLS, dtype=jnp.float32)
+    tx = sgd_momentum(optax.constant_schedule(0.05))
+
+    def mk_state():
+        return create_train_state(jax.random.key(0), model, (8, *IMG), tx)
+
+    step = build_train_step(mesh, mk_state(), compute_dtype=jnp.float32)
+    batch = shard_batch(mesh, synthetic_batch(16, IMG, NCLS))
+    return mesh, mk_state, step, batch
+
+
+def test_save_restore_roundtrip(setup, tmp_path):
+    mesh, mk_state, step, batch = setup
+    state = mk_state()
+    for _ in range(3):
+        state, _ = step(state, batch)
+    ckpt = Checkpointer(str(tmp_path / "ckpt"))
+    assert ckpt.save(3, state)
+    ckpt.wait()
+
+    restored, step_no = Checkpointer(str(tmp_path / "ckpt")).restore(mk_state())
+    assert step_no == 3
+    assert int(restored.step) == 3
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(restored.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # optimizer momentum restored too
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.opt_state),
+        jax.tree_util.tree_leaves(restored.opt_state),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_empty_dir_returns_template(setup, tmp_path):
+    _, mk_state, _, _ = setup
+    ckpt = Checkpointer(str(tmp_path / "empty"))
+    state, step_no = ckpt.restore(mk_state())
+    assert step_no is None
+    assert int(state.step) == 0
+
+
+def test_latest_step_and_max_to_keep(setup, tmp_path):
+    _, mk_state, step, batch = setup
+    state = mk_state()
+    ckpt = Checkpointer(str(tmp_path / "many"), max_to_keep=2)
+    for i in range(1, 5):
+        state, _ = step(state, batch)
+        ckpt.save(i, state)
+    ckpt.wait()
+    assert ckpt.latest_step() == 4
+    steps = sorted(
+        int(p.name) for p in (tmp_path / "many").iterdir() if p.name.isdigit()
+    )
+    assert steps == [3, 4]
+
+
+def test_resume_training_continues_identically(setup, tmp_path):
+    """Deterministic resume: train 2+2 steps with a mid-save must equal 4
+    straight steps (the reference never achieved this — broadcast resume was
+    dead code)."""
+    mesh, mk_state, step, batch = setup
+
+    state_a = mk_state()
+    for _ in range(4):
+        state_a, ma = step(state_a, batch)
+
+    state_b = mk_state()
+    for _ in range(2):
+        state_b, _ = step(state_b, batch)
+    ckpt = Checkpointer(str(tmp_path / "resume"))
+    ckpt.save(2, state_b)
+    ckpt.wait()
+    resumed, _ = Checkpointer(str(tmp_path / "resume")).restore(mk_state())
+    for _ in range(2):
+        resumed, mb = step(resumed, batch)
+
+    np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state_a.params),
+        jax.tree_util.tree_leaves(resumed.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)

@@ -1,0 +1,250 @@
+"""Data plane: converter ↔ reader roundtrip, raw-image pipeline, preparation.
+
+Mirrors the reference's guardrail strategy (SURVEY.md §4.4) with real
+automated tests over tiny synthetic JPEG trees.
+"""
+
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.data import convert_tfrecords, images, tfrecords
+from distributeddeeplearning_tpu.data.preprocessing import (
+    CHANNEL_MEANS,
+    central_crop_np,
+    normalize_np,
+)
+
+WNIDS = ["n01440764", "n01443537", "n02102040"]
+
+
+def _make_image_tree(root, per_class=4, size=(48, 56)):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for wnid in WNIDS:
+        d = root / wnid
+        d.mkdir(parents=True)
+        for i in range(per_class):
+            arr = rng.integers(0, 255, (*size, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"{wnid}_{i}.JPEG", quality=95)
+
+
+@pytest.fixture(scope="module")
+def image_tree(tmp_path_factory):
+    root = tmp_path_factory.mktemp("imagenet") / "train"
+    _make_image_tree(root)
+    return root
+
+
+@pytest.fixture(scope="module")
+def tfrecord_dir(tmp_path_factory, image_tree):
+    out = tmp_path_factory.mktemp("tfrecords")
+    n = convert_tfrecords.convert_dataset(str(image_tree), str(out), "train", 4)
+    assert n == 12
+    n = convert_tfrecords.convert_dataset(str(image_tree), str(out), "validation", 4)
+    assert n == 12
+    return out
+
+
+def test_find_image_files_labels_and_shuffle(image_tree):
+    files, labels, synsets, wnid_map = convert_tfrecords.find_image_files(
+        str(image_tree)
+    )
+    assert len(files) == 12
+    # 1-based labels by sorted wnid (background=0 convention)
+    assert wnid_map == {w: i + 1 for i, w in enumerate(sorted(WNIDS))}
+    assert set(labels) == {1, 2, 3}
+    # deterministic seed-42 shuffle
+    files2, *_ = convert_tfrecords.find_image_files(str(image_tree))
+    assert files == files2
+    assert files != sorted(files)
+
+
+def test_clean_image_bytes_png_and_cmyk(tmp_path):
+    from PIL import Image
+
+    rng = np.random.default_rng(1)
+    arr = rng.integers(0, 255, (20, 20, 3), dtype=np.uint8)
+    png = tmp_path / "x.png"
+    Image.fromarray(arr).save(png)
+    jpeg_bytes, h, w = convert_tfrecords.clean_image_bytes(png.read_bytes())
+    assert (h, w) == (20, 20)
+    img = Image.open(__import__("io").BytesIO(jpeg_bytes))
+    assert img.format == "JPEG" and img.mode == "RGB"
+
+    cmyk = tmp_path / "y.jpg"
+    Image.fromarray(arr).convert("CMYK").save(cmyk, format="JPEG")
+    jpeg_bytes, _, _ = convert_tfrecords.clean_image_bytes(cmyk.read_bytes())
+    img = Image.open(__import__("io").BytesIO(jpeg_bytes))
+    assert img.mode == "RGB"
+
+
+def test_shard_files_exist_and_missing_raises(tfrecord_dir, tmp_path):
+    names = tfrecords.shard_filenames(str(tfrecord_dir), True, num_shards=4)
+    assert len(names) == 4
+    with pytest.raises(FileNotFoundError, match="expected TFRecord shards"):
+        tfrecords.shard_filenames(str(tmp_path), True, num_shards=4)
+
+
+def test_tfrecord_roundtrip_training(tfrecord_dir):
+    it = tfrecords.input_fn(
+        str(tfrecord_dir), True, batch_size=4, num_shards=4,
+        image_size=32, shuffle_buffer=16, seed=0,
+    )
+    batch = next(it)
+    assert batch["image"].shape == (4, 32, 32, 3)
+    assert batch["image"].dtype == np.float32
+    assert batch["label"].dtype == np.int32
+    assert set(batch["label"]) <= {1, 2, 3}
+    # mean subtraction applied: values centred, not 0..255
+    assert batch["image"].min() < -20
+
+
+def test_tfrecord_eval_deterministic(tfrecord_dir):
+    def grab():
+        it = tfrecords.input_fn(
+            str(tfrecord_dir), False, batch_size=4, num_shards=4,
+            image_size=32, repeat=False,
+        )
+        return np.concatenate([b["label"] for b in it])
+
+    a, b = grab(), grab()
+    np.testing.assert_array_equal(a, b)
+    assert len(a) == 12
+
+
+def test_host_sharding_partitions_files(tfrecord_dir):
+    labels = []
+    for rank in range(2):
+        it = tfrecords.input_fn(
+            str(tfrecord_dir), False, batch_size=2, num_shards=4,
+            image_size=32, repeat=False, shard_count=2, shard_index=rank,
+        )
+        labels.append(np.concatenate([b["label"] for b in it]))
+    # disjoint halves covering everything
+    assert len(labels[0]) + len(labels[1]) == 12
+    combined = sorted(np.concatenate(labels).tolist())
+    assert combined == sorted([1, 1, 1, 1, 2, 2, 2, 2, 3, 3, 3, 3])
+
+
+def test_raw_images_pipeline(image_tree):
+    it = images.input_fn(
+        str(image_tree), True, batch_size=4, image_size=32, seed=0,
+    )
+    batch = next(it)
+    assert batch["image"].shape == (4, 32, 32, 3)
+    assert set(batch["label"]) <= {1, 2, 3}
+
+
+def test_raw_images_eval_path_works(image_tree):
+    """The reference's eval path is broken (images.py:178-197 mis-indent);
+    ours must not be."""
+    it = images.input_fn(
+        str(image_tree), False, batch_size=3, image_size=32, repeat=False,
+    )
+    batches = list(it)
+    assert len(batches) == 4
+
+
+def test_labels_agree_between_raw_and_tfrecords(image_tree, tfrecord_dir):
+    _, _, wnid_map = images.list_images(str(image_tree))
+    _, _, _, conv_map = convert_tfrecords.find_image_files(str(image_tree))
+    assert wnid_map == conv_map
+
+
+def test_normalize_np():
+    img = np.full((4, 4, 3), 128, np.uint8)
+    out = normalize_np(img)
+    np.testing.assert_allclose(
+        out[0, 0], 128 - np.asarray(CHANNEL_MEANS), rtol=1e-5
+    )
+
+
+def test_central_crop_np_shape():
+    img = np.zeros((300, 400, 3), np.uint8)
+    out = central_crop_np(img, 224)
+    assert out.shape == (224, 224, 3)
+
+
+class TestPrepareImagenet:
+    def _make_tars(self, tmp_path):
+        from PIL import Image
+
+        rng = np.random.default_rng(2)
+        src = tmp_path / "src"
+        inner_tars = []
+        for wnid in WNIDS[:2]:
+            cdir = src / wnid
+            cdir.mkdir(parents=True)
+            for i in range(2):
+                arr = rng.integers(0, 255, (16, 16, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(cdir / f"{wnid}_{i}.JPEG")
+            t = tmp_path / f"{wnid}.tar"
+            with tarfile.open(t, "w") as tar:
+                for f in sorted(cdir.iterdir()):
+                    tar.add(f, arcname=f.name)
+            inner_tars.append(t)
+        train_tar = tmp_path / "train.tar"
+        with tarfile.open(train_tar, "w") as tar:
+            for t in inner_tars:
+                tar.add(t, arcname=t.name)
+
+        val_imgs = []
+        vdir = tmp_path / "val_flat"
+        vdir.mkdir()
+        for i in range(4):
+            arr = rng.integers(0, 255, (16, 16, 3), dtype=np.uint8)
+            name = f"ILSVRC2012_val_{i:08d}.JPEG"
+            Image.fromarray(arr).save(vdir / name)
+            val_imgs.append(name)
+        val_tar = tmp_path / "val.tar"
+        with tarfile.open(val_tar, "w") as tar:
+            for name in val_imgs:
+                tar.add(vdir / name, arcname=name)
+        val_map = tmp_path / "val_map.csv"
+        rows = [f"{name},{WNIDS[i % 2]}" for i, name in enumerate(val_imgs)]
+        val_map.write_text("filename,wnid\n" + "\n".join(rows) + "\n")
+        return train_tar, val_tar, val_map
+
+    def test_full_preparation(self, tmp_path):
+        from distributeddeeplearning_tpu.data import prepare_imagenet as prep
+
+        train_tar, val_tar, val_map = self._make_tars(tmp_path)
+        target = tmp_path / "out"
+        prep.prepare_imagenet(
+            str(train_tar), str(val_tar), str(target), str(val_map),
+            check_sha1=False,
+        )
+        assert sorted(p.name for p in (target / "train").iterdir()) == WNIDS[:2]
+        assert len(list((target / "train" / WNIDS[0]).glob("*.JPEG"))) == 2
+        val_classes = sorted(p.name for p in (target / "validation").iterdir())
+        assert val_classes == WNIDS[:2]
+        total_val = sum(
+            1 for d in (target / "validation").iterdir() for _ in d.iterdir()
+        )
+        assert total_val == 4
+
+    def test_checksum_mismatch_raises(self, tmp_path):
+        from distributeddeeplearning_tpu.data import prepare_imagenet as prep
+
+        f = tmp_path / "bogus.tar"
+        f.write_bytes(b"not a tar")
+        with pytest.raises(ValueError, match="checksum mismatch"):
+            prep.verify_checksum(str(f), "0" * 40)
+
+    def test_val_map_parsing(self, tmp_path):
+        from distributeddeeplearning_tpu.data import prepare_imagenet as prep
+
+        m = tmp_path / "map.csv"
+        m.write_text("filename,wnid\na.JPEG,n01440764\nb.JPEG,n01443537\n")
+        assert prep.load_val_map(str(m)) == {
+            "a.JPEG": "n01440764",
+            "b.JPEG": "n01443537",
+        }
+        empty = tmp_path / "empty.csv"
+        empty.write_text("filename,wnid\n")
+        with pytest.raises(ValueError):
+            prep.load_val_map(str(empty))

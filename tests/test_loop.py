@@ -1,0 +1,105 @@
+"""Trainer epoch loop: metrics, checkpointing, resume, TB files, summary."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributeddeeplearning_tpu.data.synthetic import SyntheticDataset
+from distributeddeeplearning_tpu.models import get_model
+from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh
+from distributeddeeplearning_tpu.train.loop import Trainer, TrainerConfig
+from distributeddeeplearning_tpu.train.state import create_train_state, sgd_momentum
+from distributeddeeplearning_tpu.train.step import build_eval_step, build_train_step
+
+IMG = (24, 24, 3)
+NCLS = 5
+GLOBAL_BATCH = 16
+
+
+@pytest.fixture(scope="module")
+def parts():
+    mesh = create_mesh(MeshSpec())
+    model = get_model("resnet18", num_classes=NCLS, dtype=jnp.float32)
+    tx = sgd_momentum(optax.constant_schedule(0.05))
+
+    def mk_state():
+        return create_train_state(jax.random.key(0), model, (8, *IMG), tx)
+
+    train_step = build_train_step(mesh, mk_state(), compute_dtype=jnp.float32)
+    eval_step = build_eval_step(mesh, mk_state(), compute_dtype=jnp.float32)
+    return mesh, mk_state, train_step, eval_step
+
+
+def _train_stream():
+    ds = SyntheticDataset(length=10_000, image_shape=IMG, num_classes=NCLS)
+    return itertools.cycle(ds.batches(GLOBAL_BATCH))
+
+
+def _eval_stream():
+    ds = SyntheticDataset(length=2 * GLOBAL_BATCH, image_shape=IMG, num_classes=NCLS, seed=9)
+    return iter(list(ds.batches(GLOBAL_BATCH)))
+
+
+def test_fit_runs_epochs_and_reports(parts, tmp_path):
+    mesh, mk_state, train_step, eval_step = parts
+    cfg = TrainerConfig(
+        epochs=2,
+        steps_per_epoch=3,
+        global_batch_size=GLOBAL_BATCH,
+        log_every=2,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        tensorboard_dir=str(tmp_path / "tb"),
+    )
+    trainer = Trainer(mesh, train_step, eval_step=eval_step, config=cfg)
+    state, result = trainer.fit(mk_state(), _train_stream(), _eval_stream)
+
+    assert result.epochs_run == 2
+    assert int(state.step) == 6
+    assert result.total_images == 2 * 3 * GLOBAL_BATCH
+    assert result.images_per_second > 0
+    assert "loss" in result.final_train_metrics
+    assert "top1" in result.final_eval_metrics
+    # checkpoint written at each epoch boundary
+    assert trainer.checkpointer.latest_step() == 6
+    # TB event files exist
+    assert any((tmp_path / "tb").iterdir())
+
+
+def test_fit_resumes_from_checkpoint(parts, tmp_path):
+    mesh, mk_state, train_step, eval_step = parts
+    ckpt_dir = str(tmp_path / "resume_ckpt")
+    cfg1 = TrainerConfig(
+        epochs=1, steps_per_epoch=2, global_batch_size=GLOBAL_BATCH,
+        checkpoint_dir=ckpt_dir,
+    )
+    Trainer(mesh, train_step, config=cfg1).fit(mk_state(), _train_stream())
+
+    cfg2 = TrainerConfig(
+        epochs=3, steps_per_epoch=2, global_batch_size=GLOBAL_BATCH,
+        checkpoint_dir=ckpt_dir,
+    )
+    state, result = Trainer(mesh, train_step, config=cfg2).fit(
+        mk_state(), _train_stream()
+    )
+    # resumed at epoch 1, ran epochs 2..3
+    assert result.epochs_run == 2
+    assert int(state.step) == 6
+
+
+def test_fit_requires_steps_per_epoch(parts):
+    mesh, _, train_step, _ = parts
+    with pytest.raises(ValueError, match="steps_per_epoch"):
+        Trainer(mesh, train_step, config=TrainerConfig(epochs=1))
+
+
+def test_steps_per_epoch_world_scaling():
+    """steps = total_batches // world size — resnet_main.py:246-247."""
+    total_images = 1281167
+    batch_per_chip = 64
+    world = 32
+    steps = total_images // (batch_per_chip * world)
+    assert steps == total_images // batch_per_chip // world
