@@ -52,6 +52,7 @@ def build_dataset(
     repeat: bool = True,
     seed: Optional[int] = None,
     drop_remainder: bool = True,
+    augment: str = "reference",
 ):
     """tf.data pipeline over raw image files, host-sharded by FILE (each host
     reads a disjoint slice — the ``DistributedSampler`` contract)."""
@@ -69,7 +70,9 @@ def build_dataset(
         ds = ds.repeat()
 
     def load(path, label):
-        image = preprocess_image(tf.io.read_file(path), is_training, image_size)
+        image = preprocess_image(
+            tf.io.read_file(path), is_training, image_size, augment=augment
+        )
         return image, tf.cast(label, tf.int32)
 
     ds = ds.map(load, num_parallel_calls=tf.data.AUTOTUNE)

@@ -1,11 +1,16 @@
 """ImageNet preprocessing — recipe parity with the reference, TPU-shaped.
 
 Reference: ``TensorFlow_imagenet/src/imagenet_preprocessing.py:51-222`` (16g):
-train = decode JPEG → random resized crop → random horizontal flip; eval =
-aspect-preserving resize to 256-short-side → 224 central crop; both subtract
-the channel means [123.68, 116.78, 103.94] (no std division).  The recipe is
-preserved exactly — it is part of the "identical top-1" contract — but the
-implementation is tf.data ops running on the TPU-VM host CPUs feeding JAX,
+train = decode JPEG → plain bilinear resize (squash, no crop, no flip —
+``imagenet_preprocessing.py:205-208``); eval = aspect-preserving resize to
+256-short-side → 224 central crop; both subtract the channel means
+[123.68, 116.78, 103.94] (no std division).  ``augment="reference"`` (the
+default) reproduces that recipe exactly — it is part of the "identical top-1"
+contract.  ``augment="inception"`` is a deliberate, documented deviation: the
+standard Inception-style distorted-bbox crop + random horizontal flip, which
+trains to higher top-1 than the reference's resize-only path.
+
+The implementation is tf.data ops running on the TPU-VM host CPUs feeding JAX,
 emitting NHWC float32 (the reference transposes to NCHW for cuDNN at
 ``imagenet_preprocessing.py:214-219``; on TPU, NHWC is the fast layout so no
 transpose exists).
@@ -29,10 +34,18 @@ def _tf():
     return tf
 
 
+def decode_and_resize(image_bytes, image_size: int):
+    """Reference train path: decode JPEG + plain bilinear resize (squash) —
+    ``imagenet_preprocessing.py:205-208``.  No crop, no flip."""
+    tf = _tf()
+    image = tf.io.decode_jpeg(image_bytes, channels=3)
+    return tf.image.resize(image, [image_size, image_size], method="bilinear")
+
+
 def decode_and_random_crop(image_bytes, image_size: int):
-    """Train-path decode: sampled distorted bounding box crop (the standard
-    Inception-style crop the reference's train path uses via
-    ``tf.image.sample_distorted_bounding_box``), resized to the target."""
+    """Inception-style train decode (``augment="inception"`` deviation):
+    sampled distorted bounding box crop via
+    ``tf.image.sample_distorted_bounding_box``, resized to the target."""
     tf = _tf()
     shape = tf.io.extract_jpeg_shape(image_bytes)
     bbox = tf.constant([0.0, 0.0, 1.0, 1.0], shape=[1, 1, 4])
@@ -89,13 +102,24 @@ def preprocess_image(
     image_bytes,
     is_training: bool,
     image_size: int = DEFAULT_IMAGE_SIZE,
+    augment: str = "reference",
 ):
     """JPEG bytes → NHWC float32, recipe-parity with ``preprocess_image``
-    (``imagenet_preprocessing.py:180-222``)."""
+    (``imagenet_preprocessing.py:180-222``).
+
+    ``augment``: "reference" = the reference's exact train path (resize
+    only); "inception" = distorted-bbox crop + random flip (stronger,
+    documented deviation).
+    """
     tf = _tf()
     if is_training:
-        image = decode_and_random_crop(image_bytes, image_size)
-        image = tf.image.random_flip_left_right(image)
+        if augment == "inception":
+            image = decode_and_random_crop(image_bytes, image_size)
+            image = tf.image.random_flip_left_right(image)
+        elif augment == "reference":
+            image = decode_and_resize(image_bytes, image_size)
+        else:
+            raise ValueError(f"unknown augment mode {augment!r}")
     else:
         image = decode_and_center_crop(image_bytes, image_size)
     image = tf.cast(image, tf.float32)

@@ -61,7 +61,9 @@ def shard_filenames(
     return names
 
 
-def parse_record(serialized, is_training: bool, image_size: int):
+def parse_record(
+    serialized, is_training: bool, image_size: int, augment: str = "reference"
+):
     """Example proto → (image, label); schema parity with ``parse_record``
     (``data/tfrecords.py:169-217``)."""
     import tensorflow as tf
@@ -74,7 +76,7 @@ def parse_record(serialized, is_training: bool, image_size: int):
         },
     )
     image = preprocess_image(
-        features["image/encoded"], is_training, image_size
+        features["image/encoded"], is_training, image_size, augment=augment
     )
     label = tf.cast(features["image/class/label"], tf.int32)
     return image, label
@@ -93,6 +95,7 @@ def build_dataset(
     repeat: bool = True,
     seed: Optional[int] = None,
     drop_remainder: bool = True,
+    augment: str = "reference",
 ):
     """tf.data pipeline over the shard files, host-sharded.
 
@@ -118,7 +121,7 @@ def build_dataset(
     if repeat:
         ds = ds.repeat()
     ds = ds.map(
-        lambda rec: parse_record(rec, is_training, image_size),
+        lambda rec: parse_record(rec, is_training, image_size, augment),
         num_parallel_calls=tf.data.AUTOTUNE,
     )
     ds = ds.batch(batch_size, drop_remainder=drop_remainder)

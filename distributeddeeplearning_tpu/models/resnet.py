@@ -16,7 +16,7 @@ TPU-first design choices (not a translation):
   stride 1. Strided convs use the same explicit asymmetric padding as the
   reference (``conv2d_fixed_padding``, ``resnet_model.py:119-139``) so
   feature-map geometry (and thus accuracy) matches exactly.
-- BatchNorm with momentum 0.997 / eps 1e-5 matching ``resnet_model.py:29-31``;
+- BatchNorm with momentum 0.9 / eps 1e-5 matching ``resnet_model.py:10-11``;
   under global-batch ``jit`` the batch statistics are computed over the global
   (sharded) batch, i.e. cross-replica sync-BN — XLA inserts the per-channel
   reduction on ICI.
@@ -34,8 +34,8 @@ from distributeddeeplearning_tpu.models import register
 
 ModuleDef = Any
 
-BN_MOMENTUM = 0.997  # resnet_model.py:29 (decay)
-BN_EPSILON = 1e-5  # resnet_model.py:30
+BN_MOMENTUM = 0.9  # resnet_model.py:10 (BATCH_NORM_DECAY)
+BN_EPSILON = 1e-5  # resnet_model.py:11
 
 # depth -> (block, stage sizes); resnet_model.py:292-306
 RESNET_CONFIGS = {
@@ -79,7 +79,11 @@ class ConvFixedPadding(nn.Module):
             use_bias=False,
             dtype=self.dtype,
             param_dtype=jnp.float32,
-            kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+            # tf.variance_scaling_initializer() defaults (resnet_model.py:108):
+            # scale=1.0, fan_in, truncated normal.
+            kernel_init=nn.initializers.variance_scaling(
+                1.0, "fan_in", "truncated_normal"
+            ),
         )(x)
 
 

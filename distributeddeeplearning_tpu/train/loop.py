@@ -24,6 +24,7 @@ import logging
 import time
 from typing import Any, Callable, Dict, Iterator, Optional
 
+import jax
 import numpy as np
 
 from distributeddeeplearning_tpu.parallel.distributed import is_primary
@@ -141,15 +142,26 @@ class Trainer:
         epoch = start_epoch
 
         for epoch in range(start_epoch, cfg.epochs):
-            meters = {}
-            for _ in range(cfg.steps_per_epoch):
+            # Metrics accumulate ON DEVICE (one tiny async add per step);
+            # the host only blocks every log_every steps and at epoch end.
+            # A per-step float() sync would serialize dispatch and was the
+            # gap between Trainer.fit and the benchmark harness throughput.
+            acc = None
+            for step_i in range(cfg.steps_per_epoch):
                 batch = shard_batch(self.mesh, next(train_batches))
                 state, metrics = self.train_step(state, batch)
+                acc = (
+                    metrics
+                    if acc is None
+                    else jax.tree.map(lambda a, b: a + b, acc, metrics)
+                )
+                if (step_i + 1) % cfg.log_every == 0:
+                    jax.block_until_ready(acc)
                 tracker.after_step()
                 total_images += cfg.global_batch_size
-                for k, v in metrics.items():
-                    meters.setdefault(k, AverageMeter(k)).update(float(v))
-            train_metrics = {k: m.avg for k, m in meters.items()}
+            train_metrics = {
+                k: float(v) / cfg.steps_per_epoch for k, v in acc.items()
+            }
             if is_primary():
                 logger.info(
                     "epoch %d/%d: %s",
@@ -190,13 +202,37 @@ class Trainer:
         return state, result
 
     def evaluate(self, state, eval_batches: Iterator[Batch]) -> Dict[str, float]:
+        """Weighted-average eval metrics over a host-synchronized batch count.
+
+        Per-host eval file shards can yield uneven batch counts; a host with
+        extra batches would enter the eval-step collectives alone and hang
+        the pod.  All hosts therefore agree each iteration (all-gather of a
+        has-batch flag) before stepping, and stop together as soon as any
+        host runs dry.  Batches are weighted by size so ragged final batches
+        do not bias top-1.
+        """
         meters: Dict[str, AverageMeter] = {}
         steps = 0
-        for batch in eval_batches:
+        multi_host = jax.process_count() > 1
+        if multi_host:
+            from jax.experimental import multihost_utils
+        while True:
             if self.config.eval_steps is not None and steps >= self.config.eval_steps:
                 break
+            batch = next(eval_batches, None)
+            if multi_host:
+                all_have = bool(
+                    multihost_utils.process_allgather(
+                        np.asarray(batch is not None)
+                    ).all()
+                )
+                if not all_have:
+                    break
+            elif batch is None:
+                break
+            batch_size = len(next(iter(batch.values())))
             metrics = self.eval_step(state, shard_batch(self.mesh, batch))
             for k, v in metrics.items():
-                meters.setdefault(k, AverageMeter(k)).update(float(v))
+                meters.setdefault(k, AverageMeter(k)).update(float(v), batch_size)
             steps += 1
         return {k: m.avg for k, m in meters.items()}

@@ -22,7 +22,6 @@ TPU-native differences (by design, not omission):
 
 from __future__ import annotations
 
-import itertools
 import logging
 from typing import Iterator, Optional
 
@@ -43,24 +42,43 @@ def _batches(
     num_classes: int,
     seed: Optional[int],
     synthetic_length: Optional[int] = None,
+    augment: str = "reference",
 ) -> Iterator:
     if data_format == "synthetic":
+        import jax
+
         from distributeddeeplearning_tpu.data.synthetic import SyntheticDataset
 
         ds = SyntheticDataset(
             length=synthetic_length,
             image_shape=(image_size, image_size, 3),
             num_classes=num_classes,
-            seed=seed or 42,
+            # Fold the process index into the seed so hosts contribute
+            # distinct slices of the global batch rather than duplicates.
+            seed=(seed or 42) + 1000 * jax.process_index(),
         )
-        it = ds.batches(per_host_batch)
-        return itertools.cycle(it) if is_training else it
+        if len(ds) < per_host_batch:
+            raise ValueError(
+                f"synthetic dataset length {len(ds)} yields zero batches at "
+                f"per-host batch size {per_host_batch}"
+            )
+        if is_training:
+            # Regenerate each epoch instead of itertools.cycle(): cycle()
+            # caches every yielded batch on the host (~30 GB at the default
+            # synthetic epoch length).
+            def epochs() -> Iterator:
+                while True:
+                    yield from ds.batches(per_host_batch)
+
+            return epochs()
+        return ds.batches(per_host_batch)
     if data_format == "tfrecords":
         from distributeddeeplearning_tpu.data import tfrecords
 
         return tfrecords.input_fn(
             data_path, is_training, per_host_batch,
             image_size=image_size, seed=seed, repeat=is_training,
+            augment=augment,
         )
     if data_format == "images":
         from distributeddeeplearning_tpu.data import images
@@ -68,6 +86,7 @@ def _batches(
         return images.input_fn(
             data_path, is_training, per_host_batch,
             image_size=image_size, seed=seed, repeat=is_training,
+            augment=augment,
         )
     raise ValueError(f"unknown data_format {data_format!r}")
 
@@ -95,6 +114,7 @@ def main(
     seed: int = 42,
     compute_dtype: str = "bfloat16",
     distributed: Optional[bool] = None,
+    augment: str = "reference",  # "inception" = stronger train-time aug
 ):
     """Train; returns (state, FitResult)."""
     import jax
@@ -148,6 +168,7 @@ def main(
     train_iter = _batches(
         data_format, training_data_path, True, per_host_batch,
         image_size, num_classes, seed, synthetic_length=n_train,
+        augment=augment,
     )
     eval_factory = None
     if validation_data_path or data_format == "synthetic":
