@@ -1,23 +1,107 @@
 """``ddlt`` — the control-plane CLI.
 
-The TPU-native replacement for the reference's invoke task tree
-(``{{proj}}/tasks.py:180-225`` plus per-workload submit modules).  The same
-verb shape — ``setup``, ``submit.{local,remote}.{synthetic,images,tfrecords}``,
-``storage.*``, ``tensorboard``, ``runs`` — built on argparse subcommands
-(no third-party task runner).
+The TPU-native replacement for the reference's invoke task tree: the root
+namespace (``{{proj}}/tasks.py:27-225`` — setup/login/delete/tensorboard/
+runs/experiments), the per-workload submit modules
+(``tensorflow_imagenet.py:110-176`` etc. — ``submit.{local,remote}.
+{synthetic,images,tfrecords}``), and the storage scripts
+(``scripts/{storage,image,tfrecords}.py``).  Verb-for-verb, on argparse
+subcommands (no third-party task runner):
 
-This module starts minimal and grows with the framework; every verb either
-works end-to-end or states clearly what is not yet wired.
+    ddlt setup                      inv setup
+    ddlt login / select-project     inv login / select-subscription
+    ddlt imagenet submit local synthetic
+                                    inv tf-imagenet.submit.local.synthetic
+    ddlt benchmark submit remote synthetic
+                                    inv pytorch-benchmark.submit.remote.synthetic
+    ddlt storage create-bucket      inv storage.create-premium-storage (+key)
+    ddlt storage upload-images      inv storage.image.upload-data
+    ddlt storage generate-tfrecords inv storage.tfrecords.generate-tf-records
+    ddlt tensorboard / runs / experiments / delete / tpu …   (same roles)
+
+Unknown ``--flag value`` pairs after a submit verb pass through to the
+workload's ``main`` (the reference's ``script_params`` dict).  ``--dry-run``
+prints every cloud/launcher command instead of executing — the operator can
+copy/paste, and tests assert the composed command lines.
 """
 
 from __future__ import annotations
 
 import argparse
+import logging
 import sys
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 from distributeddeeplearning_tpu.config import load_config
 from distributeddeeplearning_tpu.version import __version__
+
+logger = logging.getLogger("ddlt.cli")
+
+DATA_FORMATS = ("synthetic", "images", "tfrecords")
+
+
+def _data_params(data_format: str, mode: str) -> Dict[str, Any]:
+    """Default script params per input mode — parity with the reference's
+    submit modules (``tensorflow_imagenet.py:69-70,96-97,124-125,151-152``).
+
+    Local mode resolves ``{datastore}`` to DATA_DIR, remote to the bucket
+    (``Submitter._resolve_params``); the templated shape is identical.
+    """
+    if data_format == "synthetic":
+        return {"data_format": "synthetic"}
+    if data_format == "images":
+        return {
+            "data_format": "images",
+            "training_data_path": "{datastore}/images/train",
+            "validation_data_path": "{datastore}/images/validation",
+        }
+    if data_format == "tfrecords":
+        return {
+            "data_format": "tfrecords",
+            "training_data_path": "{datastore}/tfrecords",
+            "validation_data_path": "{datastore}/tfrecords",
+        }
+    raise ValueError(f"unknown data format {data_format!r}")
+
+
+def _add_submit_tree(sub, workload: str, formats=DATA_FORMATS) -> None:
+    """Attach ``<workload> submit {local,remote} [<format>]`` verbs."""
+    wl = sub.add_parser(workload, help=f"{workload} workload")
+    wl_sub = wl.add_subparsers(dest=f"{workload}_command", required=True)
+    submit = wl_sub.add_parser("submit", help="Submit a training run")
+    submit_sub = submit.add_subparsers(dest="mode", required=True)
+    for mode in ("local", "remote"):
+        mode_p = submit_sub.add_parser(
+            mode,
+            help=f"{mode} run"
+            + (" (single-host debug path)" if mode == "local" else " (TPU pod)"),
+        )
+        if formats:
+            fmt_sub = mode_p.add_subparsers(dest="data_format", required=True)
+            for fmt in formats:
+                fmt_p = fmt_sub.add_parser(fmt, help=f"{fmt} input data")
+                fmt_p.add_argument("--experiment", default=None)
+        else:
+            mode_p.add_argument("--experiment", default=None)
+
+
+def _global_flags(parser, suppress: bool = False) -> None:
+    """--env-file / --dry-run, accepted both before and after the verb.
+
+    Subparsers get SUPPRESS defaults so a flag given before the verb is not
+    clobbered by the subparser's default when omitted after it.
+    """
+    parser.add_argument(
+        "--env-file",
+        default=argparse.SUPPRESS if suppress else None,
+        help="Path to .env (default: ./.env)",
+    )
+    parser.add_argument(
+        "--dry-run",
+        action="store_true",
+        default=argparse.SUPPRESS if suppress else False,
+        help="Print cloud/launcher commands instead of executing them",
+    )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -25,33 +109,419 @@ def build_parser() -> argparse.ArgumentParser:
         prog="ddlt",
         description="TPU-native distributed deep learning control plane.",
     )
-    parser.add_argument("--env-file", default=None, help="Path to .env (default: ./.env)")
+    _global_flags(parser)
     sub = parser.add_subparsers(dest="command")
 
     sub.add_parser("version", help="Print framework version")
 
     config_p = sub.add_parser("config", help="Configuration inspection")
-    config_sub = config_p.add_subparsers(dest="config_command")
+    config_sub = config_p.add_subparsers(dest="config_command", required=True)
     config_sub.add_parser("show", help="Print resolved configuration")
+    set_p = config_sub.add_parser("set", help="Persist KEY=VALUE into .env")
+    set_p.add_argument("key")
+    set_p.add_argument("value")
 
+    sub.add_parser("login", help="Authenticate gcloud (inv login parity)")
+    proj_p = sub.add_parser(
+        "select-project", help="Select GCP project, persist to .env"
+    )
+    proj_p.add_argument("--project", default=None)
+
+    setup_p = sub.add_parser(
+        "setup", help="Provision storage + prepare and upload data (inv setup)"
+    )
+    setup_p.add_argument("--skip-imagenet", action="store_true")
+    setup_p.add_argument("--skip-tfrecords", action="store_true")
+    setup_p.add_argument("--train-tar", default=None)
+    setup_p.add_argument("--val-tar", default=None)
+    setup_p.add_argument("--val-map", default=None)
+    setup_p.add_argument("--force", action="store_true",
+                         help="Convert partial data sets")
+
+    delete_p = sub.add_parser(
+        "delete", help="Delete the TPU pod (and optionally the bucket)"
+    )
+    delete_p.add_argument("--storage", action="store_true",
+                          help="Also delete the GCS bucket")
+
+    tpu_p = sub.add_parser("tpu", help="TPU pod lifecycle")
+    tpu_sub = tpu_p.add_subparsers(dest="tpu_command", required=True)
+    tpu_sub.add_parser("create", help="Idempotent get-or-create")
+    tpu_sub.add_parser("delete", help="Delete the pod")
+    tpu_sub.add_parser("status", help="Describe the pod")
+    tpu_sub.add_parser("list", help="List pods in the zone")
+    ssh_p = tpu_sub.add_parser("ssh", help="Run a command on pod workers")
+    ssh_p.add_argument("--worker", default="all")
+    ssh_p.add_argument("cmd", help="Shell command to run")
+    boot_p = tpu_sub.add_parser(
+        "bootstrap", help="Copy the framework to all workers and install it"
+    )
+    boot_p.add_argument("--project-dir", default=".")
+
+    st_p = sub.add_parser("storage", help="GCS data-plane tasks")
+    st_sub = st_p.add_subparsers(dest="storage_command", required=True)
+    st_sub.add_parser("create-bucket", help="Idempotent bucket create + .env write-back")
+    for verb, help_text in (
+        ("upload-images", "Upload train/validation image trees"),
+        ("download-images", "Download train/validation image trees"),
+        ("upload-tfrecords", "Upload TFRecord shards"),
+        ("download-tfrecords", "Download TFRecord shards"),
+    ):
+        v = st_sub.add_parser(verb, help=help_text)
+        v.add_argument("--data-dir", default=None)
+    prep_p = st_sub.add_parser(
+        "prepare-imagenet", help="Verify, extract, reorganize the ImageNet tars"
+    )
+    prep_p.add_argument("--train-tar", required=True)
+    prep_p.add_argument("--val-tar", required=True)
+    prep_p.add_argument("--val-map", required=True)
+    prep_p.add_argument("--target-dir", default=None)
+    prep_p.add_argument("--no-checksum", action="store_true")
+    gen_p = st_sub.add_parser(
+        "generate-tfrecords", help="Convert image trees to TFRecord shards (gated)"
+    )
+    gen_p.add_argument("--image-dir", default=None)
+    gen_p.add_argument("--output-dir", default=None)
+    gen_p.add_argument("--force", action="store_true")
+    gen_p.add_argument("--train-shards", type=int, default=None)
+    gen_p.add_argument("--validation-shards", type=int, default=None)
+
+    _add_submit_tree(sub, "imagenet")
+    _add_submit_tree(sub, "bert", formats=("synthetic", "tfrecords"))
+    _add_submit_tree(sub, "benchmark", formats=("synthetic",))
+    _add_submit_tree(sub, "experiment", formats=())
+
+    tb_p = sub.add_parser("tensorboard", help="TensorBoard over registry runs")
+    tb_p.add_argument("--experiment", default=None)
+    tb_p.add_argument("--run", default=None)
+    tb_p.add_argument("--port", type=int, default=6006)
+
+    runs_p = sub.add_parser("runs", help="List last N runs of an experiment")
+    runs_p.add_argument("--experiment", default=None)
+    runs_p.add_argument("--last", type=int, default=10)
+
+    sub.add_parser("experiments", help="List experiments in the run registry")
+
+    new_p = sub.add_parser("new", help="Generate a new project scaffold")
+    new_p.add_argument("name")
+    new_p.add_argument("--output-dir", default=".")
+    new_p.add_argument("--gcp-project", default="")
+    new_p.add_argument("--gcp-zone", default=None)
+    new_p.add_argument("--tpu-type", default=None)
+    new_p.add_argument("--gcs-bucket", default="")
+
+    _attach_globals_recursively(parser)
     return parser
 
 
+def _attach_globals_recursively(parser: argparse.ArgumentParser) -> None:
+    """Accept --env-file/--dry-run after any verb as well as before it."""
+    for action in parser._actions:
+        if isinstance(action, argparse._SubParsersAction):
+            for child in action.choices.values():
+                _global_flags(child, suppress=True)
+                _attach_globals_recursively(child)
+
+
+def _control(args):
+    from distributeddeeplearning_tpu.control import CommandRunner
+    from distributeddeeplearning_tpu.control.runs import RunRegistry
+
+    cfg = load_config(args.env_file)
+    runner = CommandRunner(dry_run=args.dry_run)
+    registry = RunRegistry(cfg.get("RUNS_DIR", "runs") or "runs")
+    return cfg, runner, registry
+
+
+def _submit(args, workload: str, extra: List[str]) -> int:
+    from distributeddeeplearning_tpu.control.submit import Submitter
+    from distributeddeeplearning_tpu.workloads._runner import parse_flags
+
+    cfg, runner, registry = _control(args)
+    params: Dict[str, Any] = {}
+    if getattr(args, "data_format", None):
+        params.update(_data_params(args.data_format, args.mode))
+    params.update(parse_flags(extra))
+    submitter = Submitter(cfg, runner, registry)
+    if args.mode == "local":
+        run = submitter.submit_local(
+            workload, params, experiment=args.experiment
+        )
+    else:
+        run = submitter.submit_remote(
+            workload, params, experiment=args.experiment
+        )
+    print(f"run {run.experiment}/{run.run_id}: {run.status}")
+    return 0 if run.status == "completed" or args.dry_run else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
     parser = build_parser()
-    args = parser.parse_args(argv)
+    args, extra = parser.parse_known_args(argv)
+    if extra and args.command not in ("imagenet", "bert", "benchmark", "experiment"):
+        parser.error(f"unrecognized arguments: {' '.join(extra)}")
+
+    if args.command is None:
+        parser.print_help()
+        return 0
     if args.command == "version":
         print(__version__)
         return 0
+
     if args.command == "config":
-        if getattr(args, "config_command", None) == "show":
-            cfg = load_config(args.env_file)
+        cfg = load_config(args.env_file)
+        if args.config_command == "show":
             for key in sorted(cfg.values):
                 print(f"{key}={cfg.values[key]}")
-            return 0
-        parser.parse_args(["config", "--help"])
-        return 2
+        else:  # set
+            cfg.persist(args.key.upper(), args.value)
+            print(f"{args.key.upper()}={args.value} -> {cfg.env_path}")
+        return 0
+
+    if args.command == "login":
+        cfg, runner, _ = _control(args)
+        runner.run(["gcloud", "auth", "login"], capture=False, check=False)
+        return 0
+
+    if args.command == "select-project":
+        cfg, runner, _ = _control(args)
+        project = args.project or cfg.get("GCP_PROJECT")
+        if not project:
+            result = runner.run(
+                ["gcloud", "config", "get-value", "project"], check=False
+            )
+            project = (result.stdout or "").strip()
+            if not project or project == "(unset)":
+                print(
+                    "no project given or configured; pass --project", file=sys.stderr
+                )
+                return 1
+        runner.run(["gcloud", "config", "set", "project", project], check=False)
+        cfg.persist("GCP_PROJECT", project)
+        print(f"GCP_PROJECT={project} -> {cfg.env_path}")
+        return 0
+
+    if args.command == "setup":
+        return _cmd_setup(args)
+
+    if args.command == "delete":
+        from distributeddeeplearning_tpu.control.storage import GcsStorage
+        from distributeddeeplearning_tpu.control.tpu import pod_from_settings
+
+        cfg, runner, _ = _control(args)
+        pod_from_settings(cfg, runner).delete()
+        if args.storage and cfg.get("GCS_BUCKET"):
+            GcsStorage(runner, bucket=cfg.get("GCS_BUCKET")).delete_bucket()
+        return 0
+
+    if args.command == "tpu":
+        return _cmd_tpu(args)
+    if args.command == "storage":
+        return _cmd_storage(args)
+    if args.command in ("imagenet", "bert", "benchmark", "experiment"):
+        return _submit(args, args.command, extra)
+    if args.command == "tensorboard":
+        return _cmd_tensorboard(args)
+    if args.command == "runs":
+        cfg, _, registry = _control(args)
+        experiment = args.experiment or cfg.get("EXPERIMENT_NAME")
+        print(registry.format_runs(experiment, args.last))
+        return 0
+    if args.command == "experiments":
+        _, _, registry = _control(args)
+        for name in registry.experiments():
+            print(name)
+        return 0
+    if args.command == "new":
+        from distributeddeeplearning_tpu.generator import generate_project
+
+        cfg = load_config(args.env_file)
+        path = generate_project(
+            args.name,
+            output_dir=args.output_dir,
+            gcp_project=args.gcp_project,
+            gcp_zone=args.gcp_zone or cfg.get("GCP_ZONE"),
+            tpu_type=args.tpu_type or cfg.get("TPU_TYPE"),
+            gcs_bucket=args.gcs_bucket,
+        )
+        print(f"generated project at {path}")
+        return 0
+
     parser.print_help()
+    return 2
+
+
+def _cmd_setup(args) -> int:
+    """Provision + data pipeline orchestration (``tasks.py setup:98-117``):
+    bucket → prepare imagenet → upload images → tfrecords → upload."""
+    from distributeddeeplearning_tpu.control.storage import (
+        GcsStorage,
+        generate_tfrecords_gated,
+    )
+
+    cfg, runner, _ = _control(args)
+    bucket_name = cfg.get("GCS_BUCKET")
+    storage = None
+    if bucket_name:
+        storage = GcsStorage(
+            runner,
+            bucket=bucket_name,
+            project=cfg.get("GCP_PROJECT") or None,
+            location=cfg.get("REGION") or None,
+        )
+        storage.ensure_bucket(cfg)
+    else:
+        logger.warning("GCS_BUCKET unset — skipping bucket provisioning")
+
+    if args.skip_imagenet:
+        print("setup complete (imagenet skipped)")
+        return 0
+
+    data_dir = cfg.get("DATA_DIR", "/data")
+    tfrecords_dir = f"{data_dir.rstrip('/')}/tfrecords"
+    if args.dry_run:
+        # The data plane is plain Python (no CommandRunner seam): honour
+        # --dry-run by describing the heavy work instead of doing it.
+        if args.train_tar:
+            print(f"[dry-run] prepare_imagenet({args.train_tar}) -> {data_dir}")
+        if storage is not None:
+            storage.upload_images(data_dir)
+        if not args.skip_tfrecords:
+            print(f"[dry-run] generate_tfrecords({data_dir}) -> {tfrecords_dir}")
+            if storage is not None:
+                storage.upload_tfrecords(tfrecords_dir)
+        print("setup complete (dry run)")
+        return 0
+    if args.train_tar and args.val_tar and args.val_map:
+        from distributeddeeplearning_tpu.data.prepare_imagenet import (
+            prepare_imagenet,
+        )
+
+        prepare_imagenet(args.train_tar, args.val_tar, data_dir, args.val_map)
+    if storage is not None:
+        storage.upload_images(data_dir)
+    if not args.skip_tfrecords:
+        generate_tfrecords_gated(data_dir, tfrecords_dir, force=args.force)
+        if storage is not None:
+            storage.upload_tfrecords(tfrecords_dir)
+    print("setup complete")
+    return 0
+
+
+def _cmd_tpu(args) -> int:
+    import json as _json
+
+    from distributeddeeplearning_tpu.control.submit import Submitter
+    from distributeddeeplearning_tpu.control.tpu import list_pods, pod_from_settings
+
+    cfg, runner, registry = _control(args)
+    pod = pod_from_settings(cfg, runner)
+    if args.tpu_command == "create":
+        created = pod.create()
+        print(f"TPU {pod.name}: {'created' if created else 'already exists'}")
+    elif args.tpu_command == "delete":
+        pod.delete()
+        print(f"TPU {pod.name}: delete requested")
+    elif args.tpu_command == "status":
+        meta = pod.describe()
+        if meta is None:
+            print(f"TPU {pod.name}: not found")
+            return 1
+        print(_json.dumps(meta, indent=2) if meta else f"TPU {pod.name}: exists")
+    elif args.tpu_command == "list":
+        for entry in list_pods(runner, cfg.get("GCP_ZONE"),
+                               cfg.get("GCP_PROJECT") or None):
+            print(entry.get("name", entry))
+    elif args.tpu_command == "ssh":
+        pod.ssh(args.cmd, worker=args.worker)
+    elif args.tpu_command == "bootstrap":
+        Submitter(cfg, runner, registry).bootstrap_pod(args.project_dir, pod=pod)
+    return 0
+
+
+def _cmd_storage(args) -> int:
+    from distributeddeeplearning_tpu.control.storage import (
+        GcsStorage,
+        generate_tfrecords_gated,
+    )
+
+    cfg, runner, _ = _control(args)
+    verb = args.storage_command
+    data_dir = getattr(args, "data_dir", None) or cfg.get("DATA_DIR", "/data")
+
+    if verb == "prepare-imagenet":
+        if args.dry_run:
+            print(
+                f"[dry-run] prepare_imagenet({args.train_tar}, {args.val_tar})"
+                f" -> {args.target_dir or cfg.get('DATA_DIR', '/data')}"
+            )
+            return 0
+        from distributeddeeplearning_tpu.data.prepare_imagenet import (
+            prepare_imagenet,
+        )
+
+        prepare_imagenet(
+            args.train_tar,
+            args.val_tar,
+            args.target_dir or cfg.get("DATA_DIR", "/data"),
+            args.val_map,
+            check_sha1=not args.no_checksum,
+        )
+        return 0
+
+    if verb == "generate-tfrecords":
+        image_dir = args.image_dir or cfg.get("DATA_DIR", "/data")
+        output_dir = args.output_dir or f"{image_dir.rstrip('/')}/tfrecords"
+        if args.dry_run:
+            print(f"[dry-run] generate_tfrecords({image_dir}) -> {output_dir}")
+            return 0
+        kwargs = {}
+        if args.train_shards:
+            kwargs["train_shards"] = args.train_shards
+        if args.validation_shards:
+            kwargs["validation_shards"] = args.validation_shards
+        counts = generate_tfrecords_gated(
+            image_dir, output_dir, force=args.force, **kwargs
+        )
+        print(f"wrote {counts} records to {output_dir}")
+        return 0
+
+    storage = GcsStorage(
+        runner,
+        bucket=cfg.get("GCS_BUCKET"),
+        project=cfg.get("GCP_PROJECT") or None,
+        location=cfg.get("REGION") or None,
+    )
+    if verb == "create-bucket":
+        created = storage.ensure_bucket(cfg)
+        print(f"bucket {storage.url}: {'created' if created else 'already exists'}")
+    elif verb == "upload-images":
+        storage.upload_images(data_dir)
+    elif verb == "download-images":
+        storage.download_images(data_dir)
+    elif verb == "upload-tfrecords":
+        storage.upload_tfrecords(f"{data_dir.rstrip('/')}/tfrecords")
+    elif verb == "download-tfrecords":
+        storage.download_tfrecords(f"{data_dir.rstrip('/')}/tfrecords")
+    return 0
+
+
+def _cmd_tensorboard(args) -> int:
+    """Point TensorBoard at registry run logdirs (``inv tensorboard`` role;
+    streaming-from-cloud becomes: checkpoints/TB events live in the run dir
+    or the bucket — pass the run's tb dir straight to tensorboard)."""
+    cfg, runner, registry = _control(args)
+    experiment = args.experiment or cfg.get("EXPERIMENT_NAME")
+    if args.run:
+        logdir = str(registry.root / experiment / args.run / "tb")
+    else:
+        logdir = str(registry.root / experiment)
+    runner.run(
+        ["tensorboard", "--logdir", logdir, "--port", str(args.port)],
+        capture=False,
+        check=False,
+    )
     return 0
 
 

@@ -1,0 +1,34 @@
+"""Control plane: cloud provisioning, storage, job submission, run tracking.
+
+The TPU-native replacement for the reference's L3 cloud-resource layer
+(``control/src/aml_compute.py``) and L4 data-plane scripts
+(``scripts/{storage,image,tfrecords}.py``).  AML clusters become TPU pods
+(gcloud TPU-VM API), blob storage becomes GCS, the MPI launcher becomes a
+per-host SSH fan-out with the JAX runtime handling rendezvous, and AML run
+tracking becomes a local JSON run registry.
+
+Every cloud interaction goes through :class:`CommandRunner`, so tests (and
+``--dry-run``) can observe the exact composed command lines without any cloud
+access — the same way the reference shells out to ``az``/``azcopy``.
+"""
+
+from distributeddeeplearning_tpu.control.command import (
+    CommandError,
+    CommandResult,
+    CommandRunner,
+)
+from distributeddeeplearning_tpu.control.runs import RunRegistry
+from distributeddeeplearning_tpu.control.storage import GcsStorage
+from distributeddeeplearning_tpu.control.submit import Submitter, complete_datastore_paths
+from distributeddeeplearning_tpu.control.tpu import TpuPod
+
+__all__ = [
+    "CommandError",
+    "CommandResult",
+    "CommandRunner",
+    "GcsStorage",
+    "RunRegistry",
+    "Submitter",
+    "TpuPod",
+    "complete_datastore_paths",
+]

@@ -1,0 +1,84 @@
+"""Subprocess command runner — the seam between tasks and the cloud CLIs.
+
+The reference's tasks shell out to ``az``/``azcopy`` through invoke's
+``c.run`` (``scripts/storage.py``, ``tasks.py``); that context object is what
+makes its tasks testable.  Here the same seam is explicit: every gcloud /
+gsutil / launcher invocation goes through :class:`CommandRunner`, which
+
+- records every argv it executes (tests assert on composed command lines),
+- supports ``dry_run`` (print, don't execute — the operator can copy/paste),
+- raises :class:`CommandError` with captured output on failure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import shlex
+import subprocess
+from typing import Dict, List, Optional, Sequence
+
+logger = logging.getLogger("ddlt.control")
+
+
+class CommandError(RuntimeError):
+    def __init__(self, argv: Sequence[str], returncode: int, stdout: str, stderr: str):
+        self.argv = list(argv)
+        self.returncode = returncode
+        self.stdout = stdout
+        self.stderr = stderr
+        super().__init__(
+            f"command failed (rc={returncode}): {shlex.join(argv)}\n{stderr or stdout}"
+        )
+
+
+@dataclasses.dataclass
+class CommandResult:
+    argv: List[str]
+    returncode: int
+    stdout: str = ""
+    stderr: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.returncode == 0
+
+
+class CommandRunner:
+    """Executes external commands; records history; optional dry-run."""
+
+    def __init__(self, dry_run: bool = False):
+        self.dry_run = dry_run
+        self.history: List[List[str]] = []
+
+    def run(
+        self,
+        argv: Sequence[str],
+        *,
+        check: bool = True,
+        capture: bool = True,
+        env: Optional[Dict[str, str]] = None,
+        timeout: Optional[float] = None,
+    ) -> CommandResult:
+        argv = [str(a) for a in argv]
+        self.history.append(argv)
+        if self.dry_run:
+            print(f"[dry-run] {shlex.join(argv)}")
+            return CommandResult(argv=argv, returncode=0)
+        logger.debug("exec: %s", shlex.join(argv))
+        proc = subprocess.run(
+            argv,
+            capture_output=capture,
+            text=True,
+            env=env,
+            timeout=timeout,
+        )
+        result = CommandResult(
+            argv=argv,
+            returncode=proc.returncode,
+            stdout=proc.stdout or "",
+            stderr=proc.stderr or "",
+        )
+        if check and not result.ok:
+            raise CommandError(argv, proc.returncode, result.stdout, result.stderr)
+        return result

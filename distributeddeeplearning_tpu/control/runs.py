@@ -1,0 +1,140 @@
+"""Run / experiment tracking — a local JSON registry.
+
+The reference delegates run tracking to the AML service: every submit creates
+a Run, ``inv runs`` lists the last N per experiment, ``inv experiments``
+lists experiments, and ``inv tensorboard`` streams the logs of running jobs
+(``tasks.py:120-169``, ``aml_compute.py:567-635``).  There is no managed
+service in the loop here, so the registry is a directory tree the operator
+owns:
+
+    <runs_root>/<experiment>/<run_id>/run.json   — submit metadata + status
+    <runs_root>/<experiment>/<run_id>/tb/        — TensorBoard event files
+    <runs_root>/<experiment>/<run_id>/ckpt/      — checkpoints
+
+Both local and remote submits register here; the TensorBoard verb points at
+an experiment's (or run's) ``tb`` dirs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import itertools
+import json
+import os
+from pathlib import Path
+from typing import Dict, List, Optional
+
+RUN_FILE = "run.json"
+
+
+@dataclasses.dataclass
+class Run:
+    run_id: str
+    experiment: str
+    workload: str
+    mode: str  # local | remote
+    argv: List[str]
+    status: str = "queued"  # queued | running | completed | failed
+    created_at: str = ""
+    finished_at: str = ""
+    returncode: Optional[int] = None
+    extra: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+
+class RunRegistry:
+    def __init__(self, root: os.PathLike | str = "runs"):
+        self.root = Path(root)
+
+    def _run_dir(self, experiment: str, run_id: str) -> Path:
+        return self.root / experiment / run_id
+
+    def new_run(
+        self,
+        experiment: str,
+        workload: str,
+        mode: str,
+        argv: List[str],
+        **extra: str,
+    ) -> Run:
+        stamp = _dt.datetime.now().strftime("%Y%m%d-%H%M%S")
+        run_id = stamp
+        for i in itertools.count(1):
+            if not self._run_dir(experiment, run_id).exists():
+                break
+            run_id = f"{stamp}-{i}"
+        run = Run(
+            run_id=run_id,
+            experiment=experiment,
+            workload=workload,
+            mode=mode,
+            argv=[str(a) for a in argv],
+            created_at=_dt.datetime.now().isoformat(timespec="seconds"),
+            extra=dict(extra),
+        )
+        self._write(run)
+        return run
+
+    def _write(self, run: Run) -> None:
+        run_dir = self._run_dir(run.experiment, run.run_id)
+        run_dir.mkdir(parents=True, exist_ok=True)
+        (run_dir / RUN_FILE).write_text(run.to_json())
+
+    def update(self, run: Run, *, status: str, returncode: Optional[int] = None) -> None:
+        run.status = status
+        if returncode is not None:
+            run.returncode = returncode
+        if status in ("completed", "failed"):
+            run.finished_at = _dt.datetime.now().isoformat(timespec="seconds")
+        self._write(run)
+
+    def run_dir(self, run: Run) -> Path:
+        return self._run_dir(run.experiment, run.run_id)
+
+    def tensorboard_dir(self, run: Run) -> Path:
+        return self.run_dir(run) / "tb"
+
+    def checkpoint_dir(self, run: Run) -> Path:
+        return self.run_dir(run) / "ckpt"
+
+    # -- listing verbs (``inv runs`` / ``inv experiments`` parity) -------
+
+    def experiments(self) -> List[str]:
+        if not self.root.exists():
+            return []
+        return sorted(d.name for d in self.root.iterdir() if d.is_dir())
+
+    def runs(self, experiment: str, last: int = 10) -> List[Run]:
+        exp_dir = self.root / experiment
+        if not exp_dir.exists():
+            return []
+        loaded: List[Run] = []
+        for run_dir in sorted(exp_dir.iterdir(), reverse=True):
+            meta = run_dir / RUN_FILE
+            if not meta.exists():
+                continue
+            try:
+                payload = json.loads(meta.read_text())
+            except json.JSONDecodeError:
+                continue
+            known = {f.name for f in dataclasses.fields(Run)}
+            loaded.append(Run(**{k: v for k, v in payload.items() if k in known}))
+            if len(loaded) >= last:
+                break
+        return loaded
+
+    def format_runs(self, experiment: str, last: int = 10) -> str:
+        """Tabulated listing (``az ml run list -o table`` role)."""
+        rows = self.runs(experiment, last)
+        if not rows:
+            return f"no runs for experiment {experiment!r}"
+        header = f"{'RUN_ID':<22}{'WORKLOAD':<14}{'MODE':<8}{'STATUS':<11}{'CREATED':<21}"
+        lines = [header, "-" * len(header)]
+        for r in rows:
+            lines.append(
+                f"{r.run_id:<22}{r.workload:<14}{r.mode:<8}{r.status:<11}{r.created_at:<21}"
+            )
+        return "\n".join(lines)

@@ -1,0 +1,158 @@
+"""GCS data-plane tasks: bucket lifecycle + image/tfrecord transfer.
+
+Capability parity with the reference's storage scripts, re-keyed for GCS:
+
+- ``create_premium_storage`` / ``create_container`` with idempotency checks
+  (``scripts/storage.py:28-112``) → ``ensure_bucket`` (describe → create on
+  miss).  GCS has no separate "container" and no harvestable account key —
+  authentication is gcloud ADC — so the ``store_key`` → ``.env`` write-back
+  contract (``storage.py:74-78``) persists the discovered/created BUCKET
+  name instead.
+- AzCopy up/down of image trees (``scripts/image.py:7-90``) and tfrecords
+  (``scripts/tfrecords.py:13-106``) → ``gcloud storage rsync -r``
+  (idempotent re-runs transfer only the delta, like azcopy's resume).
+- ``generate_tf_records`` JPEG-count gate (``scripts/tfrecords.py:112-118``)
+  → the same guardrail before conversion.
+
+Remote layout (the ``{datastore}`` root):
+    gs://<bucket>/images/train , gs://<bucket>/images/validation
+    gs://<bucket>/tfrecords/train , gs://<bucket>/tfrecords/validation
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Optional
+
+from distributeddeeplearning_tpu.control.command import CommandRunner
+
+logger = logging.getLogger("ddlt.control.storage")
+
+IMAGE_PREFIX = "images"
+TFRECORD_PREFIX = "tfrecords"
+
+
+class GcsStorage:
+    """Bucket handle; all gsutil-equivalent calls via ``gcloud storage``."""
+
+    def __init__(
+        self,
+        runner: CommandRunner,
+        *,
+        bucket: str,
+        project: Optional[str] = None,
+        location: Optional[str] = None,
+    ):
+        if not bucket:
+            raise ValueError("bucket name is required (set GCS_BUCKET)")
+        self.runner = runner
+        self.bucket = bucket.removeprefix("gs://")
+        self.project = project
+        self.location = location
+
+    @property
+    def url(self) -> str:
+        return f"gs://{self.bucket}"
+
+    def exists(self) -> bool:
+        result = self.runner.run(
+            ["gcloud", "storage", "buckets", "describe", self.url,
+             "--format", "json"],
+            check=False,
+        )
+        if self.runner.dry_run:
+            # Assume absent so dry-run shows the mutation commands too.
+            return False
+        return result.ok
+
+    def ensure_bucket(self, settings=None) -> bool:
+        """Get-or-create; persists the bucket name to ``.env`` when a
+        Settings object is passed (store_key write-back parity).  Returns
+        True when the bucket was actually created."""
+        created = False
+        if self.exists():
+            logger.info("bucket %s exists", self.url)
+        else:
+            argv = ["gcloud", "storage", "buckets", "create", self.url]
+            if self.project:
+                argv += ["--project", self.project]
+            if self.location:
+                argv += ["--location", self.location]
+            self.runner.run(argv)
+            created = True
+        if settings is not None and not self.runner.dry_run:
+            settings.persist("GCS_BUCKET", self.bucket)
+        return created
+
+    def delete_bucket(self) -> None:
+        self.runner.run(
+            ["gcloud", "storage", "rm", "-r", self.url], check=False
+        )
+
+    # -- transfer (azcopy parity) ---------------------------------------
+
+    def _rsync(self, src: str, dst: str):
+        return self.runner.run(["gcloud", "storage", "rsync", "-r", src, dst])
+
+    def upload(self, local_dir: str, remote_prefix: str):
+        return self._rsync(str(local_dir), f"{self.url}/{remote_prefix}")
+
+    def download(self, remote_prefix: str, local_dir: str):
+        Path(local_dir).mkdir(parents=True, exist_ok=True)
+        return self._rsync(f"{self.url}/{remote_prefix}", str(local_dir))
+
+    def upload_images(self, data_dir: str):
+        """Train + validation image trees (``scripts/image.py:10-14``)."""
+        self.upload(Path(data_dir) / "train", f"{IMAGE_PREFIX}/train")
+        self.upload(Path(data_dir) / "validation", f"{IMAGE_PREFIX}/validation")
+
+    def download_images(self, data_dir: str):
+        self.download(f"{IMAGE_PREFIX}/train", Path(data_dir) / "train")
+        self.download(f"{IMAGE_PREFIX}/validation", Path(data_dir) / "validation")
+
+    def upload_tfrecords(self, tfrecords_dir: str):
+        self.upload(tfrecords_dir, TFRECORD_PREFIX)
+
+    def download_tfrecords(self, tfrecords_dir: str):
+        self.download(TFRECORD_PREFIX, tfrecords_dir)
+
+
+def count_jpegs(directory: str) -> int:
+    """Recursive JPEG count — the conversion gate's input
+    (``scripts/tfrecords.py:112-118``)."""
+    root = Path(directory)
+    if not root.exists():
+        return 0
+    return sum(
+        1
+        for p in root.rglob("*")
+        if p.suffix.lower() in (".jpeg", ".jpg")
+    )
+
+
+def generate_tfrecords_gated(
+    image_dir: str,
+    output_dir: str,
+    *,
+    expected_train: int = 1281167,
+    expected_validation: int = 50000,
+    force: bool = False,
+    **convert_kwargs,
+):
+    """Convert images → TFRecords only when the JPEG counts look complete.
+
+    The reference refuses to convert partial data (``tfrecords.py:107-127``);
+    ``force=True`` overrides for subsets (tests, smoke runs).
+    """
+    from distributeddeeplearning_tpu.data.convert_tfrecords import convert_imagenet
+
+    train_count = count_jpegs(Path(image_dir) / "train")
+    val_count = count_jpegs(Path(image_dir) / "validation")
+    if not force and (train_count < expected_train or val_count < expected_validation):
+        raise RuntimeError(
+            f"refusing to convert: found {train_count} train / {val_count} "
+            f"validation JPEGs, expected {expected_train} / {expected_validation} "
+            f"(pass --force for subsets)"
+        )
+    return convert_imagenet(image_dir, output_dir, **convert_kwargs)

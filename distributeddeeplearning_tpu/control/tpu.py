@@ -1,0 +1,197 @@
+"""TPU pod lifecycle: idempotent get-or-create, SSH fan-out, delete.
+
+Capability parity with the reference's AML compute layer:
+
+- get-or-create cluster (``control/src/aml_compute.py:47-71`` — try
+  ``ComputeTarget(...)``, create on miss, idempotent on re-run) becomes
+  ``gcloud compute tpus tpu-vm describe`` → ``create`` on miss;
+- the MPI launcher geometry (``node_count × process_count_per_node``,
+  ``aml_compute.py:108-133``) becomes the TPU worker topology: ONE process
+  per TPU-VM host driving all its local chips — there is no per-chip rank;
+- per-host command fan-out (the mpirun replacement) is
+  ``gcloud compute tpus tpu-vm ssh --worker=all --command=...``; the JAX
+  runtime performs rendezvous via the TPU metadata service, so no
+  coordinator address plumbing is needed on a pod slice;
+- ``delete`` parity with ``tasks.py delete`` (resource teardown).
+
+All gcloud calls are composed here and executed through CommandRunner, so
+tests assert the exact command lines with no cloud access.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import re
+from typing import Dict, List, Optional
+
+from distributeddeeplearning_tpu.control.command import CommandRunner
+
+logger = logging.getLogger("ddlt.control.tpu")
+
+# Chips per TPU-VM host by generation; worker (host) count follows from the
+# accelerator-type chip count.  Overridable via the TPU_WORKER_COUNT setting.
+_CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5p": 4, "v5litepod": 8, "v6e": 8}
+# Generations whose type suffix counts TensorCores (2 per chip), not chips.
+_CORES_SUFFIX = {"v2", "v3", "v4", "v5p"}
+
+
+def topology_from_type(accelerator_type: str) -> Dict[str, int]:
+    """{'chips': N, 'hosts': H} for an accelerator type like ``v5litepod-32``.
+
+    The TPU analogue of the reference's fixed ``process_count_per_node=4``
+    GPU geometry (``aml_compute.py:108-109``).
+    """
+    m = re.fullmatch(r"(v\d+[a-z]*|v5litepod)-(\d+)", accelerator_type)
+    if not m:
+        raise ValueError(f"unrecognized accelerator type {accelerator_type!r}")
+    gen, count = m.group(1), int(m.group(2))
+    chips = count // 2 if gen in _CORES_SUFFIX else count
+    chips = max(chips, 1)
+    per_host = _CHIPS_PER_HOST.get(gen, 4)
+    return {"chips": chips, "hosts": max(math.ceil(chips / per_host), 1)}
+
+
+class TpuPod:
+    """Handle to one named TPU pod slice (the reference's ``.cluster``)."""
+
+    def __init__(
+        self,
+        runner: CommandRunner,
+        *,
+        name: str,
+        zone: str,
+        accelerator_type: str,
+        runtime_version: str,
+        project: Optional[str] = None,
+        preemptible: bool = False,
+    ):
+        self.runner = runner
+        self.name = name
+        self.zone = zone
+        self.accelerator_type = accelerator_type
+        self.runtime_version = runtime_version
+        self.project = project
+        self.preemptible = preemptible
+
+    # -- composed gcloud invocations ------------------------------------
+
+    def _base(self, *verbs: str) -> List[str]:
+        argv = ["gcloud", "compute", "tpus", "tpu-vm", *verbs]
+        if self.project:
+            argv += ["--project", self.project]
+        return argv
+
+    def describe(self):
+        """Pod metadata dict, or None when the pod does not exist."""
+        result = self.runner.run(
+            self._base("describe", self.name)
+            + ["--zone", self.zone, "--format", "json"],
+            check=False,
+        )
+        if self.runner.dry_run:
+            # Assume absent so dry-run shows the mutation commands too.
+            return None
+        if not result.ok:
+            return None
+        try:
+            return json.loads(result.stdout) if result.stdout.strip() else {}
+        except json.JSONDecodeError:
+            return {}
+
+    def exists(self) -> bool:
+        return self.describe() is not None
+
+    def create(self) -> bool:
+        """Get-or-create; returns True when a pod was actually created.
+
+        Idempotency parity with ``_create_cluster`` (``aml_compute.py:55-58``:
+        found → reuse, log, return).
+        """
+        if self.exists():
+            logger.info("TPU %s already exists — reusing", self.name)
+            return False
+        logger.info(
+            "creating TPU %s (%s, %s)", self.name, self.accelerator_type, self.zone
+        )
+        argv = self._base("create", self.name) + [
+            "--zone", self.zone,
+            "--accelerator-type", self.accelerator_type,
+            "--version", self.runtime_version,
+        ]
+        if self.preemptible:
+            argv.append("--preemptible")
+        self.runner.run(argv)
+        return True
+
+    def delete(self) -> None:
+        self.runner.run(
+            self._base("delete", self.name) + ["--zone", self.zone, "--quiet"],
+            check=False,
+        )
+
+    def ssh(
+        self,
+        command: str,
+        *,
+        worker: str = "all",
+        env: Optional[Dict[str, str]] = None,
+    ):
+        """Run ``command`` on pod workers — the per-host launcher fan-out
+        that replaces ``mpirun`` (``aml_compute.py:128`` distributed_backend).
+
+        ``env`` is injected as ``KEY=VALUE`` exports prefixed to the command,
+        the analogue of the estimator's environment-variable injection
+        (``DISTRIBUTED=True`` etc., ``aml_compute.py:86-90``).
+        """
+        if env:
+            import shlex
+
+            exports = " ".join(
+                f"{k}={shlex.quote(str(v))}" for k, v in sorted(env.items())
+            )
+            command = f"export {exports} && {command}"
+        return self.runner.run(
+            self._base("ssh", self.name)
+            + ["--zone", self.zone, "--worker", str(worker), "--command", command]
+        )
+
+    def scp(self, src: str, dst: str, *, worker: str = "all"):
+        """Copy files to pod workers (code distribution before launch)."""
+        return self.runner.run(
+            self._base("scp", src, f"{self.name}:{dst}")
+            + ["--zone", self.zone, "--worker", str(worker), "--recurse"]
+        )
+
+    @property
+    def topology(self) -> Dict[str, int]:
+        return topology_from_type(self.accelerator_type)
+
+
+def list_pods(runner: CommandRunner, zone: str, project: Optional[str] = None) -> list:
+    argv = ["gcloud", "compute", "tpus", "tpu-vm", "list", "--zone", zone,
+            "--format", "json"]
+    if project:
+        argv += ["--project", project]
+    result = runner.run(argv, check=False)
+    if not result.ok or not result.stdout.strip():
+        return []
+    try:
+        return json.loads(result.stdout)
+    except json.JSONDecodeError:
+        return []
+
+
+def pod_from_settings(settings, runner: CommandRunner) -> TpuPod:
+    """Construct the project pod handle from layered config (the reference
+    defaults every cluster setting from ``.env`` — ``aml_compute.py:27-44``)."""
+    return TpuPod(
+        runner,
+        name=settings.get("TPU_NAME"),
+        zone=settings.get("GCP_ZONE"),
+        accelerator_type=settings.get("TPU_TYPE"),
+        runtime_version=settings.get("TPU_RUNTIME_VERSION"),
+        project=settings.get("GCP_PROJECT") or None,
+        preemptible=settings.get_bool("TPU_PREEMPTIBLE", False),
+    )

@@ -70,6 +70,60 @@ class SyntheticDataset:
             }
 
 
+class SyntheticTextDataset:
+    """Sized, deterministic fake tokenized-text classification dataset.
+
+    The text analogue of :class:`SyntheticDataset` for BERT-style fine-tune
+    workloads (BASELINE.md "BERT-base fine-tune pod-scale DP"): random token
+    ids with a random valid length per example (the rest padding), the
+    matching attention mask, and an integer label.
+    """
+
+    def __init__(
+        self,
+        length: Optional[int] = None,
+        seq_len: int = 128,
+        vocab_size: int = 30522,
+        num_classes: int = 2,
+        seed: int = 42,
+        pad_id: int = 0,
+    ):
+        self.length = fake_data_length(25000) if length is None else length
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.num_classes = num_classes
+        self.seed = seed
+        self.pad_id = pad_id
+
+    def __len__(self) -> int:
+        return self.length
+
+    def batches(
+        self, batch_size: int, *, drop_remainder: bool = True
+    ) -> Iterator[Batch]:
+        rng = np.random.default_rng(self.seed)
+        n_batches = self.length // batch_size
+        if not drop_remainder and self.length % batch_size:
+            n_batches += 1
+        for i in range(n_batches):
+            size = min(batch_size, self.length - i * batch_size)
+            ids = rng.integers(
+                1, self.vocab_size, size=(size, self.seq_len), dtype=np.int32
+            )
+            lengths = rng.integers(1, self.seq_len + 1, size=(size,))
+            mask = (np.arange(self.seq_len)[None, :] < lengths[:, None]).astype(
+                np.int32
+            )
+            ids = np.where(mask.astype(bool), ids, self.pad_id)
+            yield {
+                "input": ids,
+                "attention_mask": mask,
+                "label": rng.integers(
+                    0, self.num_classes, size=(size,), dtype=np.int32
+                ),
+            }
+
+
 def synthetic_batch(
     batch_size: int,
     image_shape: Tuple[int, ...] = DEFAULT_IMAGE_SHAPE,

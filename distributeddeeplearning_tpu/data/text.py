@@ -1,0 +1,144 @@
+"""Tokenized-text TFRecord pipeline for BERT-style fine-tuning.
+
+The reference has no text workload; this pipeline extends the framework's
+TFRecord machinery (``data/tfrecords.py`` shape: shard files → per-host
+``shard()`` → interleave → shuffle → map → batch → prefetch) to sequence
+data so the BASELINE.md "BERT-base fine-tune pod-scale DP" config has a real
+input path.  Schema per Example:
+
+    input_ids       int64[seq_len]   token ids (pre-tokenized, padded)
+    attention_mask  int64[seq_len]   1 = real token, 0 = padding
+    label           int64            classification target
+
+``write_tfrecords`` produces shards in this schema (for tests and users
+tokenizing their own corpora); ``input_fn`` yields the framework's standard
+numpy batch dicts (``input``, ``attention_mask``, ``label``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, Iterator, Optional
+
+import numpy as np
+
+SHUFFLE_BUFFER = 10000
+
+
+def write_tfrecords(
+    examples: Iterable[Dict[str, np.ndarray]],
+    output_dir: str,
+    *,
+    prefix: str = "train",
+    num_shards: int = 8,
+) -> int:
+    """Write examples round-robin into ``{prefix}-%05d-of-%05d`` shards."""
+    import tensorflow as tf
+
+    os.makedirs(output_dir, exist_ok=True)
+    paths = [
+        os.path.join(output_dir, f"{prefix}-{i:05d}-of-{num_shards:05d}")
+        for i in range(num_shards)
+    ]
+    writers = [tf.io.TFRecordWriter(p) for p in paths]
+    count = 0
+    try:
+        for ex in examples:
+            feature = {
+                "input_ids": tf.train.Feature(
+                    int64_list=tf.train.Int64List(
+                        value=np.asarray(ex["input"]).ravel().tolist()
+                    )
+                ),
+                "attention_mask": tf.train.Feature(
+                    int64_list=tf.train.Int64List(
+                        value=np.asarray(ex["attention_mask"]).ravel().tolist()
+                    )
+                ),
+                "label": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[int(ex["label"])])
+                ),
+            }
+            record = tf.train.Example(
+                features=tf.train.Features(feature=feature)
+            ).SerializeToString()
+            writers[count % num_shards].write(record)
+            count += 1
+    finally:
+        for w in writers:
+            w.close()
+    return count
+
+
+def build_dataset(
+    data_dir: str,
+    is_training: bool,
+    batch_size: int,
+    *,
+    seq_len: int = 128,
+    prefix: Optional[str] = None,
+    shard_index: int = 0,
+    shard_count: int = 1,
+    shuffle_buffer: int = SHUFFLE_BUFFER,
+    repeat: bool = True,
+    seed: Optional[int] = None,
+    drop_remainder: bool = True,
+):
+    """tf.data pipeline over text shards, host-sharded by file."""
+    import tensorflow as tf
+
+    prefix = prefix or ("train" if is_training else "validation")
+    pattern = f"{data_dir.rstrip('/')}/{prefix}-*"
+    filenames = sorted(tf.io.gfile.glob(pattern))
+    if not filenames:
+        raise FileNotFoundError(f"no text TFRecord shards match {pattern}")
+    ds = tf.data.Dataset.from_tensor_slices(filenames)
+    if shard_count > 1:
+        ds = ds.shard(shard_count, shard_index)
+    if is_training:
+        ds = ds.shuffle(len(filenames), seed=seed, reshuffle_each_iteration=True)
+    ds = ds.interleave(
+        tf.data.TFRecordDataset,
+        cycle_length=tf.data.AUTOTUNE,
+        num_parallel_calls=tf.data.AUTOTUNE,
+        deterministic=not is_training,
+    )
+    if is_training:
+        ds = ds.shuffle(shuffle_buffer, seed=seed)
+    if repeat:
+        ds = ds.repeat()
+
+    def parse(serialized):
+        features = tf.io.parse_single_example(
+            serialized,
+            {
+                "input_ids": tf.io.FixedLenFeature([seq_len], tf.int64),
+                "attention_mask": tf.io.FixedLenFeature([seq_len], tf.int64),
+                "label": tf.io.FixedLenFeature([], tf.int64),
+            },
+        )
+        return (
+            tf.cast(features["input_ids"], tf.int32),
+            tf.cast(features["attention_mask"], tf.int32),
+            tf.cast(features["label"], tf.int32),
+        )
+
+    ds = ds.map(parse, num_parallel_calls=tf.data.AUTOTUNE)
+    ds = ds.batch(batch_size, drop_remainder=drop_remainder)
+    return ds.prefetch(tf.data.AUTOTUNE)
+
+
+def input_fn(
+    data_dir: str,
+    is_training: bool,
+    batch_size: int,
+    **kwargs,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Numpy-batch iterator, host-shard geometry from JAX topology."""
+    import jax
+
+    kwargs.setdefault("shard_count", jax.process_count())
+    kwargs.setdefault("shard_index", jax.process_index())
+    ds = build_dataset(data_dir, is_training, batch_size, **kwargs)
+    for ids, mask, label in ds.as_numpy_iterator():
+        yield {"input": ids, "attention_mask": mask, "label": label}

@@ -1,0 +1,15 @@
+"""TPU kernel-level ops: distributed attention primitives.
+
+The reference has no attention model and no custom kernels (its native layer
+was external Horovod/NCCL — SURVEY.md §2).  This package holds the ops that
+make long-context and sequence-parallel training first-class on TPU:
+ring attention (blockwise attention with k/v rotating around the ``seq``
+mesh axis via ``ppermute``, overlapping compute with ICI transfers).
+"""
+
+from distributeddeeplearning_tpu.ops.ring_attention import (
+    make_ring_attention,
+    ring_attention,
+)
+
+__all__ = ["make_ring_attention", "ring_attention"]

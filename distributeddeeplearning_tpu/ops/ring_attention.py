@@ -1,0 +1,138 @@
+"""Ring attention — sequence-parallel exact attention over the ``seq`` axis.
+
+Long sequences are sharded over the mesh's ``seq`` axis: each device holds a
+``[B, S/n, H, D]`` block of queries, keys and values.  Attention needs every
+(query, key) pair, so the key/value blocks rotate around the ring via
+``jax.lax.ppermute`` while each device accumulates its queries' attention
+over the passing blocks with the online-softmax (flash-attention) recurrence
+— numerically exact, memory O(S/n), and the ICI transfer of the next block
+overlaps with the matmul of the current one (XLA schedules the ppermute
+concurrently with compute).
+
+This is the TPU-native shape of Ring Attention (Liu et al. 2310.01889,
+blockwise parallel transformers): collectives are compiled by XLA onto the
+ICI ring — no NCCL/MPI, no host involvement.  The reference framework has no
+long-context support at all (SURVEY.md §5 "Long-context… entirely absent");
+this op is what makes BASELINE.md's pod-scale BERT config extensible past
+single-device sequence lengths.
+
+Usage: ``make_ring_attention(mesh)`` returns an ``attention_fn`` drop-in for
+``models.bert.BertEncoder`` (same signature as ``dot_product_attention``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
+
+_NEG_BIG = -1e30  # finite mask fill; -inf poisons the online-softmax max
+
+
+def _ring_body(q, k, v, mask, *, axis_name: str, ring: int, out_dtype):
+    """Per-shard blockwise attention with rotating k/v (runs in shard_map).
+
+    Shapes (local shard): q ``[B, Sq, H, D]``; k, v ``[B, Skv, H, D]``;
+    mask ``[B, 1, 1, Skv]`` bool (True = attend).  The ring is unrolled as a
+    Python loop (``ring`` is the static mesh axis size): every iteration is
+    reverse-mode differentiable and XLA overlaps each block's ppermute with
+    the previous block's matmuls.
+    """
+    depth = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(depth, jnp.float32))
+    b, sq, h, _ = q.shape
+
+    m = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    o = jnp.zeros(q.shape, jnp.float32)
+    perm = [(j, (j + 1) % ring) for j in range(ring)]
+
+    for step in range(ring):
+        scores = (
+            jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+            * scale
+        )
+        scores = jnp.where(mask, scores, _NEG_BIG)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        p = jnp.exp(scores - m_new[..., None])
+        correction = jnp.exp(m - m_new)
+        l = l * correction + p.sum(axis=-1)
+        o = o * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+        )
+        m = m_new
+        if step + 1 < ring:  # last rotation would be a no-op round trip
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+            mask = jax.lax.ppermute(mask, axis_name, perm)
+
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows (all-padding) stay finite
+    o = o / l.transpose(0, 2, 1)[..., None]
+    return o.astype(out_dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array],
+    *,
+    mesh: Mesh,
+    dtype: jnp.dtype,
+    axis_name: str = "seq",
+):
+    """Sequence-parallel attention over ``mesh``'s ``axis_name`` ring.
+
+    Drop-in for :func:`models.bert.dot_product_attention` given a mesh:
+    inputs are global ``[B, S, H, D]`` arrays (sharded batch over the data
+    axes, sequence over ``seq``); output has the same layout.
+    """
+    try:
+        from jax import shard_map as _shard_map
+
+        def shard_map(f, **kw):
+            kw.pop("check_rep", None)  # renamed in jax>=0.8 (check_vma)
+            return _shard_map(f, **kw)
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    if mesh.shape[axis_name] == 1:
+        # No ring to rotate — plain fused attention (XLA handles it).
+        from distributeddeeplearning_tpu.models.bert import dot_product_attention
+
+        return dot_product_attention(q, k, v, mask, dtype=dtype)
+
+    if mask is None:
+        mask = jnp.ones((q.shape[0], 1, 1, q.shape[1]), bool)
+
+    qkv_spec = P(DATA_AXES, axis_name, None, None)
+    mask_spec = P(DATA_AXES, None, None, axis_name)
+    body = partial(
+        _ring_body,
+        axis_name=axis_name,
+        ring=int(mesh.shape[axis_name]),
+        out_dtype=dtype,
+    )
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+        check_rep=False,
+    )(q, k, v, mask)
+
+
+def make_ring_attention(mesh: Mesh, axis_name: str = "seq"):
+    """Bind a mesh → an ``attention_fn`` for the transformer models."""
+
+    def attention_fn(q, k, v, mask, *, dtype):
+        return ring_attention(
+            q, k, v, mask, mesh=mesh, dtype=dtype, axis_name=axis_name
+        )
+
+    return attention_fn

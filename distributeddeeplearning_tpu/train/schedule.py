@@ -62,3 +62,25 @@ def goyal_lr_schedule(
 
 def constant_schedule(lr: float) -> optax.Schedule:
     return optax.constant_schedule(lr)
+
+
+def warmup_linear_decay_schedule(
+    peak_lr: float,
+    total_steps: int,
+    *,
+    warmup_fraction: float = 0.1,
+) -> optax.Schedule:
+    """BERT fine-tune schedule: linear warmup to ``peak_lr`` over the first
+    ``warmup_fraction`` of training, then linear decay to zero (Devlin et
+    al. fine-tuning recipe — no reference counterpart to cite; the reference
+    trains CNNs only)."""
+    warmup_steps = max(int(total_steps * warmup_fraction), 1)
+    return optax.join_schedules(
+        [
+            optax.linear_schedule(0.0, peak_lr, warmup_steps),
+            optax.linear_schedule(
+                peak_lr, 0.0, max(total_steps - warmup_steps, 1)
+            ),
+        ],
+        [warmup_steps],
+    )

@@ -61,6 +61,27 @@ def sgd_momentum(
     return optax.chain(*components)
 
 
+def adamw(
+    schedule: optax.Schedule,
+    *,
+    weight_decay: float = 0.01,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+    grad_clip_norm: float = 1.0,
+) -> optax.GradientTransformation:
+    """AdamW with global-norm clipping — the standard BERT fine-tune
+    optimizer (the reference has no transformer workload; these are the
+    Devlin et al. fine-tuning defaults, decoupled weight decay)."""
+    components = []
+    if grad_clip_norm:
+        components.append(optax.clip_by_global_norm(grad_clip_norm))
+    components.append(
+        optax.adamw(schedule, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
+    )
+    return optax.chain(*components)
+
+
 def create_train_state(
     rng: jax.Array,
     model,

@@ -71,11 +71,26 @@ def classification_metrics(logits: jax.Array, labels: jax.Array, loss: jax.Array
     }
 
 
-def _forward(state, params, inputs, train: bool, rngs=None):
+# Batch keys forwarded to the model as keyword inputs (transformer models
+# take the padding mask alongside the token ids).
+EXTRA_INPUT_KEYS = ("attention_mask", "token_type_ids")
+
+
+def _cast_inputs(inputs: jax.Array, compute_dtype: jnp.dtype) -> jax.Array:
+    """Cast float inputs to the compute dtype; integer inputs (token ids)
+    pass through — bf16 cannot represent vocab-sized ids exactly."""
+    if jnp.issubdtype(inputs.dtype, jnp.integer):
+        return inputs
+    return inputs.astype(compute_dtype)
+
+
+def _forward(state, params, inputs, train: bool, rngs=None, extras=None):
     """Apply the model, handling BN batch_stats models and stat-free models."""
     has_stats = bool(jax.tree_util.tree_leaves(state.batch_stats))
     variables = {"params": params}
-    kwargs = {"rngs": rngs} if rngs else {}
+    kwargs = dict(extras or {})
+    if rngs:
+        kwargs["rngs"] = rngs
     if has_stats:
         variables["batch_stats"] = state.batch_stats
         if train:
@@ -83,7 +98,8 @@ def _forward(state, params, inputs, train: bool, rngs=None):
                 variables, inputs, train=True, mutable=["batch_stats"], **kwargs
             )
             return logits, new_vars["batch_stats"]
-        return state.apply_fn(variables, inputs, train=False), state.batch_stats
+        kwargs.pop("rngs", None)
+        return state.apply_fn(variables, inputs, train=False, **kwargs), state.batch_stats
     return state.apply_fn(variables, inputs, train=train, **kwargs), state.batch_stats
 
 
@@ -150,11 +166,17 @@ def build_train_step(
     def step_fn(state, batch):
         inputs = batch.get("image", batch.get("input"))
         labels = batch["label"]
+        extras = {k: batch[k] for k in EXTRA_INPUT_KEYS if k in batch}
         rngs = {"dropout": jax.random.fold_in(base_rng, state.step)}
 
         def compute_loss(params):
             logits, new_stats = _forward(
-                state, params, inputs.astype(compute_dtype), train=True, rngs=rngs
+                state,
+                params,
+                _cast_inputs(inputs, compute_dtype),
+                train=True,
+                rngs=rngs,
+                extras=extras,
             )
             loss = loss_fn(logits, labels, label_smoothing=label_smoothing)
             return loss, (logits, new_stats)
@@ -195,7 +217,14 @@ def build_eval_step(
     def step_fn(state, batch):
         inputs = batch.get("image", batch.get("input"))
         labels = batch["label"]
-        logits, _ = _forward(state, state.params, inputs.astype(compute_dtype), train=False)
+        extras = {k: batch[k] for k in EXTRA_INPUT_KEYS if k in batch}
+        logits, _ = _forward(
+            state,
+            state.params,
+            _cast_inputs(inputs, compute_dtype),
+            train=False,
+            extras=extras,
+        )
         loss = cross_entropy_loss(logits, labels)
         return classification_metrics(logits, labels, loss)
 

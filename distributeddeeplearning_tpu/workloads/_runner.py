@@ -1,0 +1,86 @@
+"""Keyword-flag entry-point runner for workload modules.
+
+The reference's training scripts are launched per rank with python-fire
+parsing keyword flags (``resnet_main.py:312`` ``fire.Fire(main)``,
+``imagenet_pytorch_horovod.py:446``).  This is the dependency-free
+equivalent: ``run_from_argv(main)`` turns ``--key value`` / ``--key=value``
+argv into ``main(**kwargs)``, coercing each value by the parameter's default
+(and falling back to literal parsing for ``None``-defaulted params), so
+
+    python -m distributeddeeplearning_tpu.workloads.imagenet --epochs 1
+
+is the launch contract for both local subprocess and remote SSH fan-out.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import sys
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _coerce(raw: str, default: Any) -> Any:
+    if isinstance(default, bool):
+        lowered = raw.lower()
+        if lowered in ("true", "t", "yes", "y", "1"):
+            return True
+        if lowered in ("false", "f", "no", "n", "0"):
+            return False
+        raise ValueError(f"cannot interpret {raw!r} as a boolean")
+    if isinstance(default, int) and not isinstance(default, bool):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    if isinstance(default, str):
+        return raw
+    # None / missing default: try literal (int/float/bool/None), else string.
+    try:
+        return ast.literal_eval(raw)
+    except (ValueError, SyntaxError):
+        return raw
+
+
+def parse_flags(argv: List[str]) -> Dict[str, str]:
+    """``--key value`` / ``--key=value`` argv → raw-string kwargs."""
+    kwargs: Dict[str, str] = {}
+    i = 0
+    while i < len(argv):
+        token = argv[i]
+        if not token.startswith("--"):
+            raise SystemExit(f"unexpected positional argument {token!r}")
+        token = token[2:]
+        if "=" in token:
+            key, raw = token.split("=", 1)
+        else:
+            if i + 1 >= len(argv):
+                raise SystemExit(f"flag --{token} expects a value")
+            key, raw = token, argv[i + 1]
+            i += 1
+        kwargs[key.replace("-", "_")] = raw
+        i += 1
+    return kwargs
+
+
+def run_from_argv(
+    main_fn: Callable, argv: Optional[List[str]] = None
+) -> Any:
+    """Parse flags against ``main_fn``'s signature and call it."""
+    argv = sys.argv[1:] if argv is None else argv
+    raw_kwargs = parse_flags(argv)
+    sig = inspect.signature(main_fn)
+    kwargs: Dict[str, Any] = {}
+    for key, raw in raw_kwargs.items():
+        if key not in sig.parameters:
+            raise SystemExit(
+                f"unknown flag --{key}; valid: "
+                + ", ".join(f"--{p}" for p in sig.parameters)
+            )
+        default = sig.parameters[key].default
+        if default is inspect.Parameter.empty:
+            default = None
+        try:
+            kwargs[key] = _coerce(raw, default)
+        except ValueError as exc:
+            raise SystemExit(f"bad value for --{key}: {exc}")
+    return main_fn(**kwargs)

@@ -1,0 +1,98 @@
+"""Synthetic throughput benchmark workload — submit-able entry point.
+
+Role parity with BOTH reference benchmark workloads:
+- PyTorch synthetic benchmark (``PyTorch_benchmark/src/
+  pytorch_synthetic_benchmark.py:51-126``): model by name, fixed resident
+  batch, warmup + timed iters, img/sec mean ±1.96σ per device and total;
+- TF benchmark (``TensorFlow_benchmark/tensorflow_benchmark.py:44-56``):
+  the tf_cnn_benchmarks role — resnet50/inceptionv3 at batch 256 mixed
+  precision — is played by our own models (no external suite to clone).
+
+Launchable via ``python -m distributeddeeplearning_tpu.workloads.benchmark``
+(the submit contract) or ``ddlt benchmark submit …``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+logger = logging.getLogger("ddlt.workloads.benchmark")
+
+
+def main(
+    *,
+    model: str = "resnet50",
+    data_format: str = "synthetic",  # benchmark is synthetic-only
+    batch_size: int = 64,  # per chip; pytorch_benchmark.py:25 submit default
+    image_size: int = 224,
+    num_classes: int = 1001,
+    num_iters: int = 10,  # pytorch_synthetic_benchmark.py iteration geometry
+    num_batches_per_iter: int = 10,
+    num_warmup_batches: int = 10,
+    compute_dtype: str = "bfloat16",  # the reference's --use_fp16 analogue
+    base_lr: float = 0.0125,
+    tensorboard_dir: Optional[str] = None,  # accepted for submit parity
+    save_filepath: Optional[str] = None,  # accepted for submit parity
+    distributed: Optional[bool] = None,
+):
+    """Run the synthetic benchmark; returns BenchmarkResult."""
+    if data_format != "synthetic":
+        raise ValueError("the benchmark workload is synthetic-only")
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.data.synthetic import synthetic_batch
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.parallel import (
+        MeshSpec,
+        create_mesh,
+        initialize,
+        shard_batch,
+    )
+    from distributeddeeplearning_tpu.train.benchmark import run_benchmark
+    from distributeddeeplearning_tpu.train.schedule import goyal_lr_schedule
+    from distributeddeeplearning_tpu.train.state import (
+        create_train_state,
+        sgd_momentum,
+    )
+    from distributeddeeplearning_tpu.train.step import build_train_step
+
+    ctx = initialize(force=distributed)
+    mesh = create_mesh(MeshSpec())
+    n_dev = mesh.devices.size
+    dtype = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
+    global_batch = batch_size * n_dev
+    img_shape = (image_size, image_size, 3)
+
+    net = get_model(model, num_classes=num_classes, dtype=dtype)
+    sched = goyal_lr_schedule(base_lr, n_dev, steps_per_epoch=5004)
+    tx = sgd_momentum(sched)
+    state = create_train_state(
+        jax.random.key(0), net, (batch_size, *img_shape), tx
+    )
+    step = build_train_step(mesh, state, schedule=sched, compute_dtype=dtype)
+    batch = shard_batch(mesh, synthetic_batch(global_batch, img_shape, num_classes))
+
+    log = logger.info if ctx.is_primary else (lambda *_: None)
+    return run_benchmark(
+        step,
+        state,
+        batch,
+        model_name=model,
+        batch_size_per_chip=batch_size,
+        num_devices=n_dev,
+        num_warmup_batches=num_warmup_batches,
+        num_iters=num_iters,
+        num_batches_per_iter=num_batches_per_iter,
+        log=log,
+    )
+
+
+if __name__ == "__main__":
+    import logging as _logging
+
+    _logging.basicConfig(level=_logging.INFO)
+    from distributeddeeplearning_tpu.workloads._runner import run_from_argv
+
+    run_from_argv(main)

@@ -1,0 +1,135 @@
+"""Blank experiment template — the scaffold users fill in with their model.
+
+Role parity with the reference's experiment templates
+(``TensorFlow_experiment/src/train_model.py:15-153`` — a skeleton
+Estimator+Horovod script with an intentional hole at ``:18``
+(``NUM_CLASSES = #``), and ``PyTorch_experiment/``).  This scaffold is the
+TPU-native shape of the same idea: a complete, runnable training skeleton
+over the framework's mesh/step/loop machinery, with the model definition as
+the single hole.  Out of the box it trains a trivial MLP on synthetic data
+so the submit path is verifiable end-to-end; replace :func:`build_model`
+(and the data iterators, if you have real data) with your own.
+
+Launchable via ``python -m distributeddeeplearning_tpu.workloads.experiment``
+or ``ddlt experiment submit {local,remote}``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+logger = logging.getLogger("ddlt.workloads.experiment")
+
+# ----------------------------------------------------------------------
+# EDIT HERE: your model.  The template ships a placeholder MLP so that the
+# submit machinery is testable before you write any code (the reference's
+# template instead ships a hole that fails until filled — train_model.py:18).
+# ----------------------------------------------------------------------
+
+
+def build_model(num_classes: int, dtype):
+    import flax.linen as nn
+
+    class Mlp(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            x = x.reshape((x.shape[0], -1)).astype(dtype)
+            x = nn.Dense(128, dtype=dtype)(x)
+            x = nn.relu(x)
+            import jax.numpy as jnp
+
+            return nn.Dense(num_classes, dtype=dtype)(x).astype(jnp.float32)
+
+    return Mlp()
+
+
+def main(
+    *,
+    epochs: int = 1,
+    batch_size: int = 32,  # per chip
+    num_classes: int = 10,
+    feature_dim: int = 64,
+    base_lr: float = 0.01,
+    train_examples: int = 2048,
+    seed: int = 42,
+    compute_dtype: str = "bfloat16",
+    save_filepath: Optional[str] = None,
+    tensorboard_dir: Optional[str] = None,
+    resume: bool = True,
+    distributed: Optional[bool] = None,
+):
+    """Train the experiment model; returns (state, FitResult)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.data.synthetic import SyntheticDataset
+    from distributeddeeplearning_tpu.parallel import (
+        MeshSpec,
+        create_mesh,
+        initialize,
+    )
+    from distributeddeeplearning_tpu.train.loop import Trainer, TrainerConfig
+    from distributeddeeplearning_tpu.train.schedule import goyal_lr_schedule
+    from distributeddeeplearning_tpu.train.state import (
+        create_train_state,
+        sgd_momentum,
+    )
+    from distributeddeeplearning_tpu.train.step import (
+        build_eval_step,
+        build_train_step,
+    )
+
+    ctx = initialize(force=distributed)
+    mesh = create_mesh(MeshSpec())
+    world = mesh.devices.size
+    global_batch = batch_size * world
+    per_host_batch = global_batch // ctx.process_count
+    steps_per_epoch = max(train_examples // global_batch, 1)
+    dtype = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
+
+    model = build_model(num_classes, dtype)
+    schedule = goyal_lr_schedule(base_lr, world, steps_per_epoch)
+    tx = sgd_momentum(schedule)
+    state = create_train_state(
+        jax.random.key(seed), model, (1, feature_dim, 1, 1), tx
+    )
+    train_step = build_train_step(mesh, state, schedule=schedule, compute_dtype=dtype)
+    eval_step = build_eval_step(mesh, state, compute_dtype=dtype)
+
+    ds = SyntheticDataset(
+        length=train_examples,
+        image_shape=(feature_dim, 1, 1),
+        num_classes=num_classes,
+        seed=seed + 1000 * jax.process_index(),
+    )
+
+    def train_iter():
+        while True:
+            yield from ds.batches(per_host_batch)
+
+    trainer = Trainer(
+        mesh,
+        train_step,
+        eval_step=eval_step,
+        config=TrainerConfig(
+            epochs=epochs,
+            steps_per_epoch=steps_per_epoch,
+            global_batch_size=global_batch,
+            checkpoint_dir=save_filepath,
+            tensorboard_dir=tensorboard_dir,
+            resume=resume,
+        ),
+    )
+    return trainer.fit(
+        state, train_iter(), lambda: ds.batches(per_host_batch)
+    )
+
+
+if __name__ == "__main__":
+    import logging as _logging
+
+    _logging.basicConfig(level=_logging.INFO)
+    from distributeddeeplearning_tpu.workloads._runner import run_from_argv
+
+    run_from_argv(main)

@@ -193,3 +193,12 @@ def main(
         ),
     )
     return trainer.fit(state, train_iter, eval_factory)
+
+
+if __name__ == "__main__":
+    import logging as _logging
+
+    _logging.basicConfig(level=_logging.INFO)
+    from distributeddeeplearning_tpu.workloads._runner import run_from_argv
+
+    run_from_argv(main)
