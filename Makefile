@@ -1,0 +1,68 @@
+# Root lifecycle + smoke-generation Makefile.
+#
+# Role parity with the reference's two Makefiles:
+#   - the root Makefile's non-interactive project smoke-gen + clean
+#     (reference Makefile:5-19, `make cookiecutter` / `make clean`), here
+#     driven by `ddlt new` instead of cookiecutter;
+#   - the {{proj}}/Makefile control-plane lifecycle (build/run/bash/stop,
+#     reference {{proj}}/Makefile:27-53), here `docker-build` / `docker-run` /
+#     `docker-bash` / `docker-stop` over docker/Dockerfile.control.
+
+PROJECT ?= smoke-test-project
+IMAGE ?= ddlt-control
+DATA_DIR ?= /data
+
+.PHONY: install test test-fast generate clean bench-smoke bench scaling dryrun \
+        docker-build docker-run docker-bash docker-stop
+
+install:
+	pip install -e .
+
+test:
+	python -m pytest tests/ -x -q
+
+test-fast:
+	python -m pytest tests/ -x -q -m "not slow"
+
+# Smoke-generate a project non-interactively (reference Makefile:5-16).
+generate:
+	python -m distributeddeeplearning_tpu.cli.main new $(PROJECT) \
+		--gcp-project smoke-project --gcs-bucket smoke-bucket
+	@test -f $(PROJECT)/.env && test -f $(PROJECT)/Makefile \
+		&& echo "generated $(PROJECT) OK"
+
+clean:
+	rm -rf $(PROJECT)
+
+# Headline benchmark (tiny shapes — CI smoke; drop --small for real numbers).
+bench-smoke:
+	python bench.py --small
+
+bench:
+	python bench.py
+
+# Allreduce scaling-efficiency sweep (BASELINE.json north-star #2).
+scaling:
+	python bench.py --devices 1,2,4,8 --small
+
+# Multi-chip sharding dry run on a virtual 8-device pod.
+dryrun:
+	python __graft_entry__.py 8
+
+# ---- Control-plane container lifecycle ({{proj}}/Makefile:27-53 parity) ----
+
+docker-build:
+	docker build -t $(IMAGE) -f docker/Dockerfile.control .
+
+docker-run:
+	docker run -d --name $(IMAGE) \
+		-v $(CURDIR):/workspace -v $(DATA_DIR):/data \
+		-p 6006:6006 -p 9999:9999 \
+		$(IMAGE) sleep infinity
+	docker exec -it $(IMAGE) tmux new-session -s control
+
+docker-bash:
+	docker exec -it $(IMAGE) tmux attach-session -t control
+
+docker-stop:
+	docker rm -f $(IMAGE)

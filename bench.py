@@ -8,7 +8,21 @@ fp16 there), fixed device-resident synthetic batch, warmup then timed
 iterations, img/sec mean ±1.96σ.  The timed unit is the full jitted train
 step (fwd+bwd+update — allreduce included when >1 chip).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Beyond the reference's img/sec, the JSON line carries ``mfu`` (sustained
+model FLOP/s from XLA's compiled cost model ÷ chip peak bf16 FLOP/s) so the
+number is auditable against the hardware ceiling, and ``--trace-dir`` wraps
+one timed iteration in ``jax.profiler.trace`` for xprof analysis.
+
+Modes:
+  default              one mesh over all visible chips; primary JSON line
+  --devices 1,2,4,8    allreduce scaling-efficiency sweep (BASELINE.json's
+                       second north-star metric): loop mesh sizes, report
+                       efficiency(N) = total_img_sec(N) / (N × img_sec(1)).
+                       Re-execs itself onto a virtual N-device CPU platform
+                       when fewer real chips are visible (same recipe as
+                       ``__graft_entry__.dryrun_multichip``).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 ``vs_baseline`` normalizes against 720 img/sec — a representative
 tf_cnn_benchmarks ResNet-50 fp16 bs-256 single-V100 figure (the reference
 publishes no numbers, BASELINE.md; 10% above/below this is the target band).
@@ -17,10 +31,175 @@ publishes no numbers, BASELINE.md; 10% above/below this is the target band).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import sys
 
 V100_TF_CNN_BENCHMARKS_IMG_SEC = 720.0
+
+
+def _build_bench(args, devices=None):
+    """(step, state, batch, n_dev) for one mesh over ``devices``."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.data.synthetic import synthetic_batch
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.parallel import (
+        MeshSpec,
+        create_mesh,
+        shard_batch,
+    )
+    from distributeddeeplearning_tpu.train.schedule import goyal_lr_schedule
+    from distributeddeeplearning_tpu.train.state import (
+        create_train_state,
+        sgd_momentum,
+    )
+    from distributeddeeplearning_tpu.train.step import build_train_step
+
+    mesh = create_mesh(MeshSpec(), devices=devices)
+    n_dev = mesh.devices.size
+    global_batch = args.batch_size * n_dev
+    img_shape = (args.image_size, args.image_size, 3)
+    dtype = jnp.float32 if args.fp32 else jnp.bfloat16
+
+    model = get_model(args.model, num_classes=1001, dtype=dtype)
+    sched = goyal_lr_schedule(0.0125, n_dev, steps_per_epoch=5004)
+    tx = sgd_momentum(sched)
+    state = create_train_state(
+        jax.random.key(0), model, (args.batch_size, *img_shape), tx
+    )
+    step = build_train_step(mesh, state, schedule=sched, compute_dtype=dtype)
+    batch = shard_batch(mesh, synthetic_batch(global_batch, img_shape))
+    return step, state, batch, n_dev
+
+
+def _run_single(args) -> int:
+    import jax
+
+    from distributeddeeplearning_tpu.train.benchmark import run_benchmark
+    from distributeddeeplearning_tpu.utils.hardware import (
+        peak_bf16_flops,
+        step_flops,
+    )
+
+    step, state, batch, n_dev = _build_bench(args)
+    global_batch = args.batch_size * n_dev
+
+    # Compile once up front (lowering does not consume the donated state) and
+    # read XLA's own FLOP count for the step; the benchmark loop below hits
+    # the same jit cache, so this adds no second compilation.
+    flops = None
+    try:
+        flops = step_flops(step.lower(state, batch).compile())
+    except Exception:
+        pass
+
+    trace = (
+        jax.profiler.trace(args.trace_dir)
+        if args.trace_dir
+        else contextlib.nullcontext()
+    )
+    with trace:
+        result = run_benchmark(
+            step,
+            state,
+            batch,
+            model_name=args.model,
+            batch_size_per_chip=args.batch_size,
+            num_devices=n_dev,
+            num_warmup_batches=args.num_warmup,
+            num_iters=args.num_iters,
+            num_batches_per_iter=args.num_batches_per_iter,
+            log=lambda msg: print(msg, file=sys.stderr),
+        )
+
+    mfu = None
+    peak = peak_bf16_flops()
+    if flops is not None and peak is not None:
+        steps_per_sec = result.img_sec_total / global_batch
+        mfu = flops * steps_per_sec / (n_dev * peak)
+
+    line = {
+        "metric": f"{args.model}_synthetic_train_img_sec_per_chip",
+        "value": round(result.img_sec_per_chip_mean, 1),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(
+            result.img_sec_per_chip_mean / V100_TF_CNN_BENCHMARKS_IMG_SEC, 3
+        ),
+    }
+    if mfu is not None:
+        line["mfu"] = round(mfu, 4)
+    if flops is not None:
+        line["step_gflops"] = round(flops / 1e9, 1)
+    print(json.dumps(line))
+    return 0
+
+
+def _run_scaling(args) -> int:
+    """Allreduce scaling-efficiency sweep over increasing mesh sizes."""
+    from distributeddeeplearning_tpu.utils.virtual_pod import (
+        force_cpu_platform_if_child,
+        reexec_with_virtual_pod,
+    )
+
+    sizes = sorted({int(x) for x in args.devices.split(",")})
+    if sizes[0] != 1:
+        # Efficiency is defined against single-chip throughput; a sweep
+        # without the 1-chip point would silently rebase to its smallest
+        # mesh and overstate scaling.
+        print("[scaling] adding the 1-chip baseline point", file=sys.stderr)
+        sizes.insert(0, 1)
+
+    import jax
+
+    force_cpu_platform_if_child()
+    if len(jax.devices()) < max(sizes):
+        return reexec_with_virtual_pod(max(sizes))
+
+    from distributeddeeplearning_tpu.train.benchmark import run_benchmark
+
+    totals = {}
+    for n in sizes:
+        trace = (
+            jax.profiler.trace(f"{args.trace_dir}/devices-{n}")
+            if args.trace_dir
+            else contextlib.nullcontext()
+        )
+        step, state, batch, n_dev = _build_bench(args, devices=jax.devices()[:n])
+        with trace:
+            result = run_benchmark(
+                step,
+                state,
+                batch,
+                model_name=args.model,
+                batch_size_per_chip=args.batch_size,
+                num_devices=n_dev,
+                num_warmup_batches=args.num_warmup,
+                num_iters=args.num_iters,
+                num_batches_per_iter=args.num_batches_per_iter,
+                log=lambda msg, n=n: print(f"[{n} dev] {msg}", file=sys.stderr),
+            )
+        totals[n] = result.img_sec_total
+
+    per_chip_1 = totals[1]
+    efficiency = {
+        str(n): round(totals[n] / (n * per_chip_1), 4) for n in sizes
+    }
+    n_max = sizes[-1]
+    print(
+        json.dumps(
+            {
+                "metric": f"{args.model}_scaling_efficiency_{n_max}chip",
+                "value": efficiency[str(n_max)],
+                "unit": "ratio_vs_linear",
+                "vs_baseline": efficiency[str(n_max)],
+                "img_sec_total": {str(n): round(v, 1) for n, v in totals.items()},
+                "efficiency": efficiency,
+            }
+        )
+    )
+    return 0
 
 
 def main() -> int:
@@ -34,71 +213,29 @@ def main() -> int:
     parser.add_argument(
         "--small", action="store_true", help="tiny shapes for CI smoke"
     )
+    parser.add_argument(
+        "--fp32", action="store_true", help="disable bf16 compute"
+    )
+    parser.add_argument(
+        "--devices",
+        default=None,
+        help="comma list of mesh sizes for the scaling-efficiency sweep, "
+        "e.g. 1,2,4,8 (forces a virtual CPU pod if too few real chips)",
+    )
+    parser.add_argument(
+        "--trace-dir",
+        default=None,
+        help="write a jax.profiler trace of the timed run here",
+    )
     args = parser.parse_args()
 
     if args.small:
         args.batch_size, args.image_size = 16, 64
         args.num_iters, args.num_batches_per_iter, args.num_warmup = 2, 2, 1
 
-    import jax
-    import jax.numpy as jnp
-    import optax
-
-    from distributeddeeplearning_tpu.data.synthetic import synthetic_batch
-    from distributeddeeplearning_tpu.models import get_model
-    from distributeddeeplearning_tpu.parallel import (
-        MeshSpec,
-        create_mesh,
-        shard_batch,
-    )
-    from distributeddeeplearning_tpu.train.benchmark import run_benchmark
-    from distributeddeeplearning_tpu.train.schedule import goyal_lr_schedule
-    from distributeddeeplearning_tpu.train.state import (
-        create_train_state,
-        sgd_momentum,
-    )
-    from distributeddeeplearning_tpu.train.step import build_train_step
-
-    mesh = create_mesh(MeshSpec())
-    n_dev = mesh.devices.size
-    global_batch = args.batch_size * n_dev
-    img_shape = (args.image_size, args.image_size, 3)
-
-    model = get_model(args.model, num_classes=1001, dtype=jnp.bfloat16)
-    sched = goyal_lr_schedule(0.0125, n_dev, steps_per_epoch=5004)
-    tx = sgd_momentum(sched)
-    state = create_train_state(
-        jax.random.key(0), model, (args.batch_size, *img_shape), tx
-    )
-    step = build_train_step(mesh, state, schedule=sched)
-    batch = shard_batch(mesh, synthetic_batch(global_batch, img_shape))
-
-    result = run_benchmark(
-        step,
-        state,
-        batch,
-        model_name=args.model,
-        batch_size_per_chip=args.batch_size,
-        num_devices=n_dev,
-        num_warmup_batches=args.num_warmup,
-        num_iters=args.num_iters,
-        num_batches_per_iter=args.num_batches_per_iter,
-        log=lambda msg: print(msg, file=sys.stderr),
-    )
-
-    print(
-        json.dumps(
-            {
-                "metric": f"{args.model}_synthetic_train_img_sec_per_chip",
-                "value": round(result.img_sec_per_chip_mean, 1),
-                "unit": "img/sec/chip",
-                "vs_baseline": round(
-                    result.img_sec_per_chip_mean / V100_TF_CNN_BENCHMARKS_IMG_SEC, 3
-                ),
-            }
-        )
-    )
-    return 0
+    if args.devices:
+        return _run_scaling(args)
+    return _run_single(args)
 
 
 if __name__ == "__main__":

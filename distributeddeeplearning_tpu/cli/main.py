@@ -177,6 +177,20 @@ def build_parser() -> argparse.ArgumentParser:
     prep_p.add_argument("--val-map", required=True)
     prep_p.add_argument("--target-dir", default=None)
     prep_p.add_argument("--no-checksum", action="store_true")
+    ci_p = st_sub.add_parser(
+        "class-index",
+        help="Derive the wnid->class mapping from the train tree; "
+        "optionally verify a canonical imagenet_class_index.json against it",
+    )
+    ci_p.add_argument("--image-dir", default=None)
+    ci_p.add_argument("--output", default=None,
+                      help="Where to write imagenet_nounid_to_class.json")
+    ci_p.add_argument("--verify", default=None,
+                      help="Canonical keras-style class index JSON to check")
+    ci_p.add_argument("--label-offset", type=int, default=1,
+                      help="1 (default) = this framework's 1001-class "
+                      "background-head labels; 0 = the reference's 0-based "
+                      "imagenet_nounid_to_class.json format")
     gen_p = st_sub.add_parser(
         "generate-tfrecords", help="Convert image trees to TFRecord shards (gated)"
     )
@@ -468,6 +482,34 @@ def _cmd_storage(args) -> int:
             args.val_map,
             check_sha1=not args.no_checksum,
         )
+        return 0
+
+    if verb == "class-index":
+        from distributeddeeplearning_tpu.data.class_index import (
+            build_nounid_to_class,
+            load_class_index,
+            verify_class_index,
+            write_nounid_to_class,
+        )
+
+        image_dir = args.image_dir or f"{data_dir.rstrip('/')}/train"
+        if args.dry_run:
+            print(f"[dry-run] build_nounid_to_class({image_dir})")
+            return 0
+        mapping = build_nounid_to_class(image_dir, label_offset=args.label_offset)
+        output = args.output or f"{data_dir.rstrip('/')}/imagenet_nounid_to_class.json"
+        write_nounid_to_class(mapping, output)
+        print(f"wrote {len(mapping)}-class mapping to {output}")
+        if args.verify:
+            problems = verify_class_index(
+                load_class_index(args.verify), mapping,
+                label_offset=args.label_offset,
+            )
+            if problems:
+                for p in problems[:20]:
+                    print(f"MISMATCH: {p}", file=sys.stderr)
+                return 1
+            print(f"verified against {args.verify}: OK")
         return 0
 
     if verb == "generate-tfrecords":

@@ -227,8 +227,13 @@ class Submitter:
         pod = pod or pod_from_settings(self.settings, self.runner)
         pod.create()
         pod.scp(str(Path(project_dir)), remote_dir, worker="all")
-        pod.ssh(
-            f"pip install -q -e {remote_dir}",
-            worker="all",
-        )
+        install = f"pip install -q -e {remote_dir}"
+        if (Path(project_dir) / "envs" / "requirements-tpu.txt").exists():
+            # Pin the worker runtime (envs/requirements-tpu.txt — the
+            # environment_gpu.yml role) before installing the framework.
+            install = (
+                f"pip install -q -r {remote_dir}/envs/requirements-tpu.txt"
+                f" && {install}"
+            )
+        pod.ssh(install, worker="all")
         return pod

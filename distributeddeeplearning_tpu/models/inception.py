@@ -157,10 +157,50 @@ class InceptionE(nn.Module):
         return jnp.concatenate([b1, b2, b3, b4], axis=-1)
 
 
+class InceptionAux(nn.Module):
+    """Auxiliary classifier off the 17×17×768 grid (Szegedy et al. §4) —
+    tf_cnn_benchmarks' InceptionV3 carries this head; its loss enters
+    weighted 0.4 (see ``inception_aux_loss``)."""
+
+    num_classes: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
+        x = ConvBN(128, 1, dtype=self.dtype)(x, train)
+        x = ConvBN(768, 5, padding="VALID", dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=jnp.float32,
+            name="aux_head",
+        )(x)
+        return x.astype(jnp.float32)
+
+
+def inception_aux_loss(outputs, labels, *, label_smoothing: float = 0.0,
+                       aux_weight: float = 0.4):
+    """Combined main + 0.4×aux cross-entropy for aux-enabled training.
+
+    Pass as ``loss_fn`` to ``build_train_step`` when the model was built
+    with ``aux_logits=True`` (train-mode forward returns (logits, aux)).
+    """
+    from distributeddeeplearning_tpu.train.step import cross_entropy_loss
+
+    logits, aux = outputs
+    return cross_entropy_loss(
+        logits, labels, label_smoothing=label_smoothing
+    ) + aux_weight * cross_entropy_loss(
+        aux, labels, label_smoothing=label_smoothing
+    )
+
+
 class InceptionV3(nn.Module):
     num_classes: int = 1001
     dtype: jnp.dtype = jnp.bfloat16
     dropout_rate: float = 0.0  # benchmarks run without dropout
+    aux_logits: bool = False  # throughput benchmarks run headless; enable
+    # for accuracy-parity training (tf_cnn_benchmarks' inception3 has it)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -182,6 +222,11 @@ class InceptionV3(nn.Module):
         x = InceptionC(160, dtype=self.dtype)(x, train)
         x = InceptionC(160, dtype=self.dtype)(x, train)
         x = InceptionC(192, dtype=self.dtype)(x, train)
+        aux = None
+        if self.aux_logits and (train or self.is_initializing()):
+            # Run at init regardless of mode so the aux params always exist
+            # (create_train_state initializes with train=False).
+            aux = InceptionAux(self.num_classes, dtype=self.dtype)(x, train)
         x = InceptionD(dtype=self.dtype)(x, train)
         x = InceptionE(dtype=self.dtype)(x, train)
         x = InceptionE(dtype=self.dtype)(x, train)
@@ -192,7 +237,10 @@ class InceptionV3(nn.Module):
         x = nn.Dense(
             self.num_classes, dtype=self.dtype, param_dtype=jnp.float32, name="head"
         )(x)
-        return x.astype(jnp.float32)
+        x = x.astype(jnp.float32)
+        if self.aux_logits and train and not self.is_initializing():
+            return x, aux
+        return x
 
 
 register("inceptionv3")(InceptionV3)

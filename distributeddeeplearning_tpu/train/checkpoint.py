@@ -45,7 +45,13 @@ class Checkpointer:
         *,
         max_to_keep: int = 5,
         save_interval_steps: int = 1,
+        async_save: bool = True,
     ):
+        """``async_save`` (the TPU-native default): ``save()`` copies the
+        state to host synchronously, then serializes/writes in a background
+        thread — the step loop never stalls on storage.  Safe with donated
+        train states because the device→host copy completes before save()
+        returns.  ``wait()``/``close()`` drain pending writes."""
         self.directory = Path(directory).absolute()
         self._mgr = ocp.CheckpointManager(
             self.directory,
@@ -53,7 +59,7 @@ class Checkpointer:
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps,
                 create=True,
-                enable_async_checkpointing=False,
+                enable_async_checkpointing=async_save,
             ),
         )
 

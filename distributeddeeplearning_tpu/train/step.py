@@ -185,7 +185,10 @@ def build_train_step(
             compute_loss, has_aux=True
         )(state.params)
         new_state = state.apply_gradients(grads, batch_stats=new_stats)
-        metrics = classification_metrics(logits, labels, loss)
+        # Aux-head models (InceptionV3 aux_logits=True) return (main, aux);
+        # metrics report on the main head only.
+        main_logits = logits[0] if isinstance(logits, tuple) else logits
+        metrics = classification_metrics(main_logits, labels, loss)
         if schedule is not None:
             metrics["lr"] = schedule(state.step).astype(jnp.float32)
         return new_state, metrics

@@ -1,0 +1,69 @@
+"""Device peak-FLOPs lookup for MFU accounting.
+
+The reference never reports utilization — only img/sec
+(``pytorch_synthetic_benchmark.py:119-126``).  On TPU, img/sec alone hides
+whether the MXU is actually busy, so the benchmark harness divides sustained
+model FLOP/s by the chip's peak bf16 FLOP/s (MFU, as defined in the PaLM
+paper's appendix).  Peaks are the public per-chip bf16/fp16 dense figures
+from the TPU and GPU datasheets.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+# device_kind substring (lowercased) -> peak dense bf16/fp16 FLOP/s per chip
+_PEAK_BF16_FLOPS = [
+    ("v6e", 918e12),  # Trillium
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),  # device_kind "TPU v5 lite" (v5e)
+    ("v5litepod", 197e12),
+    ("v5", 459e12),  # bare "TPU v5" = v5p
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+    ("h100", 989e12),
+    ("a100", 312e12),
+    ("v100", 125e12),
+]
+
+
+def peak_bf16_flops(device: Optional[jax.Device] = None) -> Optional[float]:
+    """Peak dense bf16 FLOP/s for ``device`` (default: first visible device).
+
+    Returns None when the device kind is unrecognized (e.g. the CPU backend
+    used by the virtual test mesh) — callers should then omit MFU rather
+    than report a made-up number.
+    """
+    if device is None:
+        device = jax.devices()[0]
+    kind = device.device_kind.lower()
+    for key, peak in _PEAK_BF16_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def step_flops(compiled) -> Optional[float]:
+    """Total FLOPs of one execution of a compiled XLA program.
+
+    Reads XLA's own cost model via ``Compiled.cost_analysis()`` — the same
+    count the profiler uses — so it automatically tracks rematerialization
+    and fusion decisions instead of trusting an analytic formula.
+    """
+    try:
+        analysis = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    if not analysis:
+        return None
+    flops = analysis.get("flops")
+    if flops is None or flops <= 0:
+        return None
+    return float(flops)

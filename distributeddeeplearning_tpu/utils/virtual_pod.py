@@ -1,0 +1,72 @@
+"""Virtual CPU pod re-exec: run a driver on N faked devices.
+
+The interactive environment pins a hardware PJRT plugin via a site hook, so
+neither ``JAX_PLATFORMS=cpu`` in the environment nor
+``--xla_force_host_platform_device_count`` alone can conjure an N-device
+mesh once Python has started.  The working recipe (``tests/conftest.py``):
+set both env vars **and** flip ``jax.config`` to the CPU platform before the
+first backend query — which, for a driver that may already have touched the
+backend, means re-exec'ing itself in a fresh child process.
+
+Shared by ``__graft_entry__.dryrun_multichip`` and ``bench.py --devices``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+from typing import List, Optional
+
+SENTINEL = "_DDLT_VIRTUAL_POD_REEXEC"
+
+
+def is_reexec_child() -> bool:
+    return os.environ.get(SENTINEL) == "1"
+
+
+def force_cpu_platform_if_child() -> None:
+    """In a re-exec'd child, pin the CPU platform before backend init.
+
+    Must run before the first ``jax.devices()``/array op; a no-op in the
+    parent or when the backend is already initialized.
+    """
+    if not is_reexec_child():
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized; the caller's count check decides
+
+
+def reexec_with_virtual_pod(
+    n_devices: int, argv: Optional[List[str]] = None
+) -> int:
+    """Re-exec ``argv`` (default: this process's command line) in a child
+    with an ``n_devices``-device virtual CPU platform forced at startup.
+    Returns the child's exit code."""
+    if is_reexec_child():
+        import jax
+
+        raise RuntimeError(
+            f"re-exec'd child still sees {len(jax.devices())} devices "
+            f"(< {n_devices}); virtual CPU platform did not take effect"
+        )
+    env = dict(os.environ)
+    env[SENTINEL] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    want = f"--xla_force_host_platform_device_count={n_devices}"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", want, flags
+        )
+    else:
+        flags = (flags + " " + want).strip()
+    env["XLA_FLAGS"] = flags
+    if argv is None:
+        argv = [sys.executable, os.path.abspath(sys.argv[0]), *sys.argv[1:]]
+    return subprocess.run(argv, env=env).returncode

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Per-worker TPU-VM environment setup.
+#
+# The role of the reference's AML image build (conda env from
+# environment_gpu.yml + base MPI/CUDA image, aml_compute.py:354-393): turn a
+# fresh TPU VM into a worker that can run ddlt workloads.  Invoked on every
+# worker by `ddlt tpu ssh --worker all 'bash ~/ddlt/envs/setup-tpu-vm.sh'`
+# or automatically after `ddlt tpu bootstrap`.
+set -euo pipefail
+
+DDLT_DIR="${DDLT_DIR:-$HOME/ddlt}"
+
+python3 -m pip install -q --upgrade pip
+python3 -m pip install -q -r "$DDLT_DIR/envs/requirements-tpu.txt"
+python3 -m pip install -q -e "$DDLT_DIR"
+
+# Sanity: every worker must see its local TPU chips.
+python3 - <<'EOF'
+import jax
+print(f"worker {jax.process_index()}/{jax.process_count()}: "
+      f"{jax.local_device_count()} local device(s): "
+      f"{jax.local_devices()[0].device_kind}")
+EOF

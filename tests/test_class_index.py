@@ -1,0 +1,151 @@
+"""Class-index data contracts (SURVEY.md §2 #19).
+
+The reference ships ``imagenet_nounid_to_class.json`` (consumed by
+``data/images.py:12-24``) and the canonical ``scripts/imagenet_class_index.json``.
+Here the first is derived from the data tree and the second is verified
+against it; these tests pin both formats, the framework's 1-based training
+labels (background=0), and the off-by-one detection.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from distributeddeeplearning_tpu.data.class_index import (
+    build_nounid_to_class,
+    class_names,
+    list_wnids,
+    load_class_index,
+    load_nounid_to_class,
+    verify_class_index,
+    write_nounid_to_class,
+)
+
+WNIDS = ["n01440764", "n01443537", "n01484850"]
+CANONICAL = {
+    "0": ["n01440764", "tench"],
+    "1": ["n01443537", "goldfish"],
+    "2": ["n01484850", "great_white_shark"],
+}
+
+
+@pytest.fixture
+def image_dir(tmp_path):
+    for wnid in WNIDS:
+        (tmp_path / "train" / wnid).mkdir(parents=True)
+    # non-directory clutter must be ignored
+    (tmp_path / "train" / "LICENSE.txt").write_text("x")
+    return tmp_path / "train"
+
+
+def _canonical(tmp_path, entries):
+    path = tmp_path / "imagenet_class_index.json"
+    path.write_text(json.dumps(entries))
+    return path
+
+
+def test_derive_matches_training_labels(image_dir):
+    assert list_wnids(image_dir) == WNIDS
+    # Default: the 1-based labels the loaders train with (background=0,
+    # data/images.py {w: i+1}; data/tfrecords.py "1-based, background=0").
+    assert build_nounid_to_class(image_dir) == {
+        "n01440764": 1,
+        "n01443537": 2,
+        "n01484850": 3,
+    }
+    # Reference file-format parity: 0-based.
+    assert build_nounid_to_class(image_dir, label_offset=0) == {
+        "n01440764": 0,
+        "n01443537": 1,
+        "n01484850": 2,
+    }
+
+
+def test_write_and_load_roundtrip_reference_format(image_dir, tmp_path):
+    mapping = build_nounid_to_class(image_dir, label_offset=0)
+    out = tmp_path / "imagenet_nounid_to_class.json"
+    write_nounid_to_class(mapping, out)
+    # Reference format: ONE json object mapping wnid -> int class, 0-based.
+    raw = json.loads(out.read_text())
+    assert raw == {"n01440764": 0, "n01443537": 1, "n01484850": 2}
+    assert load_nounid_to_class(out) == mapping
+
+
+def test_verify_agreement_and_names(image_dir, tmp_path):
+    index = load_class_index(_canonical(tmp_path, CANONICAL))
+    # Default offsets line up: canonical 0-based + 1 == training labels.
+    assert verify_class_index(index, build_nounid_to_class(image_dir)) == []
+    # And the 0-based pair agrees at offset 0.
+    assert verify_class_index(
+        index, build_nounid_to_class(image_dir, label_offset=0), label_offset=0
+    ) == []
+    assert class_names(index, 3) == ["tench", "goldfish", "great_white_shark"]
+
+
+def test_verify_detects_background_offset_mismatch(image_dir, tmp_path):
+    """A 0-based mapping checked against the training convention (offset 1)
+    must fail — this is exactly the off-by-one the tool exists to catch."""
+    index = load_class_index(_canonical(tmp_path, CANONICAL))
+    zero_based = build_nounid_to_class(image_dir, label_offset=0)
+    problems = verify_class_index(index, zero_based)  # default offset 1
+    assert len(problems) == 3 and "offset 1" in problems[0]
+
+
+def test_verify_detects_missing_and_misordered_wnids(image_dir, tmp_path):
+    canonical = _canonical(
+        tmp_path,
+        {
+            "0": ["n01443537", "goldfish"],  # swapped order
+            "1": ["n01440764", "tench"],
+            "2": ["n99999999", "ghost"],  # not in the tree
+        },
+    )
+    problems = verify_class_index(
+        load_class_index(canonical), build_nounid_to_class(image_dir)
+    )
+    assert any("missing from data tree" in p for p in problems)
+    assert any("n01443537" in p for p in problems)
+
+
+def test_malformed_class_index_rejected(tmp_path):
+    bad = _canonical(tmp_path, {"0": ["only-one-field"]})
+    with pytest.raises(ValueError, match="not \\[wnid, text\\]"):
+        load_class_index(bad)
+
+
+def test_cli_class_index_verb(image_dir, tmp_path, monkeypatch, capsys):
+    from distributeddeeplearning_tpu.cli.main import main
+
+    monkeypatch.chdir(tmp_path)
+    canonical = _canonical(tmp_path, CANONICAL)
+    rc = main(
+        [
+            "storage", "class-index",
+            "--image-dir", str(image_dir),
+            "--output", str(tmp_path / "mapping.json"),
+            "--verify", str(canonical),
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "3-class mapping" in out and "OK" in out
+    # Default CLI output: the 1-based training labels.
+    assert json.loads((tmp_path / "mapping.json").read_text()) == {
+        "n01440764": 1, "n01443537": 2, "n01484850": 3,
+    }
+    # --label-offset 0 writes the reference's 0-based format and verifies.
+    rc = main(
+        [
+            "storage", "class-index",
+            "--image-dir", str(image_dir),
+            "--output", str(tmp_path / "mapping0.json"),
+            "--verify", str(canonical),
+            "--label-offset", "0",
+        ]
+    )
+    assert rc == 0
+    assert json.loads((tmp_path / "mapping0.json").read_text()) == {
+        "n01440764": 0, "n01443537": 1, "n01484850": 2,
+    }

@@ -101,3 +101,29 @@ def test_inception_v3_shape():
     v = model.init(jax.random.key(0), jnp.zeros((1, 299, 299, 3)), train=False)
     out = model.apply(v, jnp.zeros((1, 299, 299, 3)), train=False)
     assert out.shape == (1, 7)
+
+
+@pytest.mark.slow
+def test_inception_v3_aux_logits():
+    """tf_cnn_benchmarks' inception3 carries an aux classifier whose loss
+    enters weighted 0.4; train-mode forward returns (main, aux), eval-mode
+    returns main only, and the combined loss is finite."""
+    import numpy as np
+
+    from distributeddeeplearning_tpu.models.inception import inception_aux_loss
+
+    model = get_model(
+        "inceptionv3", num_classes=7, dtype=jnp.float32, aux_logits=True
+    )
+    x = jnp.zeros((2, 299, 299, 3))
+    v = model.init(jax.random.key(0), x, train=False)
+    assert "InceptionAux_0" in v["params"]
+    (main, aux), _ = model.apply(
+        v, x, train=True, mutable=["batch_stats"]
+    )
+    assert main.shape == (2, 7) and aux.shape == (2, 7)
+    labels = jnp.array([1, 2])
+    loss = inception_aux_loss((main, aux), labels)
+    assert np.isfinite(float(loss))
+    out_eval = model.apply(v, x, train=False)
+    assert out_eval.shape == (2, 7)
