@@ -1,0 +1,182 @@
+"""TF-free TFRecord input pipeline on the native reader.
+
+An alternative to ``data/tfrecords.py`` that needs **no TensorFlow**: the
+framework's own C record reader (``data/csrc/ddlt_records.c`` via
+``data/_native.py``) streams and CRC-verifies the frames, the minimal
+wire-format walker extracts ``image/encoded``/``image/class/label`` (same
+schema as the reference converter, ``convert_imagenet_to_tf_records.py:111-146``),
+PIL decodes JPEGs on a thread pool, and numpy applies the reference
+preprocessing recipe (``imagenet_preprocessing.py:180-222``):
+
+- train: decode → plain bilinear resize (squash, no crop/flip);
+- eval: aspect-preserving central crop (224/256 of the short side) →
+  bilinear resize;
+- both: channel-mean subtraction, NHWC float32.
+
+Semantics mirror ``tfrecords.input_fn``: per-host file sharding defaulted
+from the JAX process topology, deterministic eval order, drop_remainder on
+the training path.  Use it on hosts where TF is unavailable or unwanted;
+tf.data remains the default for its deeper prefetch pipeline.
+"""
+
+from __future__ import annotations
+
+import random
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from distributeddeeplearning_tpu.data._native import (
+    RecordReader,
+    example_bytes,
+    example_int64,
+)
+from distributeddeeplearning_tpu.data.preprocessing import (
+    CHANNEL_MEANS,
+    DEFAULT_IMAGE_SIZE,
+    RESIZE_MIN,
+)
+from distributeddeeplearning_tpu.data.tfrecords import shard_filenames
+
+
+def _decode_train(jpeg: bytes, image_size: int) -> np.ndarray:
+    """Reference train path: decode + bilinear squash-resize."""
+    from PIL import Image
+    import io
+
+    img = Image.open(io.BytesIO(jpeg)).convert("RGB")
+    img = img.resize((image_size, image_size), Image.BILINEAR)
+    return np.asarray(img, np.float32)
+
+
+def _decode_eval(jpeg: bytes, image_size: int) -> np.ndarray:
+    """Eval path: central crop of image_size/RESIZE_MIN of the short side,
+    then bilinear resize — ``decode_and_center_crop`` parity."""
+    from PIL import Image
+    import io
+
+    img = Image.open(io.BytesIO(jpeg)).convert("RGB")
+    w, h = img.size
+    crop = int(min(h, w) * (image_size / RESIZE_MIN))
+    x = (w - crop) // 2
+    y = (h - crop) // 2
+    img = img.crop((x, y, x + crop, y + crop))
+    img = img.resize((image_size, image_size), Image.BILINEAR)
+    return np.asarray(img, np.float32)
+
+
+def _records(files, *, verify: bool) -> Iterator[bytes]:
+    for path in files:
+        yield from RecordReader(path, verify=verify)
+
+
+def _shuffled_records(
+    files, rng: random.Random, buffer_size: int, *, verify: bool
+) -> Iterator[bytes]:
+    """Reservoir-style record shuffle: the tfrecords pipeline's
+    ``ds.shuffle(SHUFFLE_BUFFER)`` role — file order alone repeats each
+    shard's internal order every epoch."""
+    buf = []
+    for rec in _records(files, verify=verify):
+        if len(buf) < buffer_size:
+            buf.append(rec)
+            continue
+        idx = rng.randrange(buffer_size)
+        out, buf[idx] = buf[idx], rec
+        yield out
+    rng.shuffle(buf)
+    yield from buf
+
+
+def native_input_fn(
+    data_dir: str,
+    is_training: bool,
+    batch_size: int,
+    *,
+    image_size: int = DEFAULT_IMAGE_SIZE,
+    num_shards: Optional[int] = None,
+    shard_count: Optional[int] = None,
+    shard_index: Optional[int] = None,
+    repeat: Optional[bool] = None,
+    drop_remainder: bool = True,
+    seed: int = 0,
+    num_workers: int = 8,
+    shuffle_buffer: int = 10000,
+    verify_crc: bool = True,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Numpy-batch iterator ``{"image", "label"}`` — TF-free.
+
+    Defaults the host shard geometry from the JAX process topology exactly
+    like ``tfrecords.input_fn``; files round-robin to hosts by position
+    (``files[shard_index::shard_count]``).  Training shuffles both file
+    order and records (``shuffle_buffer``, the tf pipeline's 10k default).
+    """
+    if data_dir.startswith("gs://"):
+        raise ValueError(
+            "the native pipeline reads local files only — download the "
+            "shards first (ddlt storage download-tfrecords) or use the "
+            "tf.data pipeline (input_pipeline='tf') for gs:// paths"
+        )
+    if shard_count is None or shard_index is None:
+        import jax
+
+        shard_count = jax.process_count() if shard_count is None else shard_count
+        shard_index = jax.process_index() if shard_index is None else shard_index
+    if repeat is None:
+        repeat = is_training
+
+    files = shard_filenames(data_dir, is_training, num_shards)
+    files = files[shard_index::shard_count]
+    decode = _decode_train if is_training else _decode_eval
+    means = np.asarray(CHANNEL_MEANS, np.float32)
+    rng = random.Random(seed)
+
+    def one(record: bytes):
+        jpeg = example_bytes(record, "image/encoded")
+        label = example_int64(record, "image/class/label")
+        if jpeg is None or label is None:
+            raise ValueError("record missing image/encoded or image/class/label")
+        return decode(jpeg, image_size) - means, np.int32(label)
+
+    with ThreadPoolExecutor(max_workers=num_workers) as pool:
+        while True:
+            order = list(files)
+            if is_training:
+                rng.shuffle(order)
+            images, labels = [], []
+            # Window the decode fan-out so at most ~4 batches are in flight.
+            window = max(batch_size * 4, num_workers)
+            pending = []
+            if is_training and shuffle_buffer > 1:
+                record_iter = _shuffled_records(
+                    order, rng, shuffle_buffer, verify=verify_crc
+                )
+            else:
+                record_iter = _records(order, verify=verify_crc)
+            exhausted = False
+            while not exhausted or pending:
+                while not exhausted and len(pending) < window:
+                    rec = next(record_iter, None)
+                    if rec is None:
+                        exhausted = True
+                        break
+                    pending.append(pool.submit(one, rec))
+                if not pending:
+                    break
+                image, label = pending.pop(0).result()
+                images.append(image)
+                labels.append(label)
+                if len(images) == batch_size:
+                    yield {
+                        "image": np.stack(images),
+                        "label": np.asarray(labels, np.int32),
+                    }
+                    images, labels = [], []
+            if images and not drop_remainder:
+                yield {
+                    "image": np.stack(images),
+                    "label": np.asarray(labels, np.int32),
+                }
+            if not repeat:
+                return

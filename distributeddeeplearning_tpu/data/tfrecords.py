@@ -44,21 +44,40 @@ def shard_filenames(
     num_shards: Optional[int] = None,
 ) -> list:
     """Expected shard paths; existence-checked like ``get_filenames``
-    (``data/tfrecords.py:124-140``)."""
-    if num_shards is None:
-        num_shards = DEFAULT_TRAIN_SHARDS if is_training else DEFAULT_VALIDATION_SHARDS
+    (``data/tfrecords.py:124-140``).
+
+    ``num_shards=None`` first auto-detects the count from existing
+    ``<prefix>-*-of-NNNNN`` files (non-standard layouts, e.g. subsampled
+    datasets, keep working), falling back to the reference defaults
+    (1014/128); the per-shard existence check still runs either way.  With
+    mixed layouts in one directory the LARGEST count wins deterministically
+    — a stale-but-larger set then fails the existence check loudly instead
+    of silently training on a subsample.
+    """
     prefix = "train" if is_training else "validation"
+    present = None
+    if data_dir.startswith("gs://"):
+        # GCS shards (remote runs read the bucket directly — no mount).
+        # One glob serves both shard-count detection and the existence
+        # check: 1014 serial stat RPCs per host would stall startup by
+        # minutes.
+        import tensorflow as tf
+
+        present = set(tf.io.gfile.glob(f"{data_dir.rstrip('/')}/{prefix}-*"))
+    if num_shards is None:
+        found = (
+            present
+            if present is not None
+            else _glob_local(data_dir, prefix)
+        )
+        num_shards = _max_shard_count(found) or (
+            DEFAULT_TRAIN_SHARDS if is_training else DEFAULT_VALIDATION_SHARDS
+        )
     names = [
         f"{data_dir.rstrip('/')}/{prefix}-{i:05d}-of-{num_shards:05d}"
         for i in range(num_shards)
     ]
-    if data_dir.startswith("gs://"):
-        # GCS shards (remote runs read the bucket directly — no mount).
-        # One glob instead of per-shard stat RPCs: 1014 serial round trips
-        # per host would stall pipeline startup by minutes.
-        import tensorflow as tf
-
-        present = set(tf.io.gfile.glob(f"{data_dir.rstrip('/')}/{prefix}-*"))
+    if present is not None:
         missing = [n for n in names if n not in present]
     else:
         missing = [n for n in names if not os.path.exists(n)]
@@ -68,6 +87,24 @@ def shard_filenames(
             f"first: {missing[0]}"
         )
     return names
+
+
+def _glob_local(data_dir: str, prefix: str) -> list:
+    import glob as _glob
+
+    return _glob.glob(f"{data_dir.rstrip('/')}/{prefix}-*")
+
+
+def _max_shard_count(found) -> Optional[int]:
+    """Largest ``-of-NNNNN`` suffix among the files — deterministic."""
+    import re as _re
+
+    counts = [
+        int(m.group(1))
+        for name in found
+        if (m := _re.search(r"-of-(\d+)$", name))
+    ]
+    return max(counts) if counts else None
 
 
 def parse_record(

@@ -1,15 +1,27 @@
-"""TPU kernel-level ops: distributed attention primitives.
+"""TPU kernel-level ops: attention primitives.
 
 The reference has no attention model and no custom kernels (its native layer
 was external Horovod/NCCL — SURVEY.md §2).  This package holds the ops that
 make long-context and sequence-parallel training first-class on TPU:
-ring attention (blockwise attention with k/v rotating around the ``seq``
-mesh axis via ``ppermute``, overlapping compute with ICI transfers).
+
+- ring attention — blockwise attention with k/v rotating around the ``seq``
+  mesh axis via ``ppermute``, overlapping compute with ICI transfers;
+- flash attention — the single-device Pallas kernel: the same online-softmax
+  recurrence blocked over VMEM, O(block²) memory, custom VJP.
 """
 
+from distributeddeeplearning_tpu.ops.flash_attention import (
+    flash_attention,
+    make_flash_attention,
+)
 from distributeddeeplearning_tpu.ops.ring_attention import (
     make_ring_attention,
     ring_attention,
 )
 
-__all__ = ["make_ring_attention", "ring_attention"]
+__all__ = [
+    "flash_attention",
+    "make_flash_attention",
+    "make_ring_attention",
+    "ring_attention",
+]

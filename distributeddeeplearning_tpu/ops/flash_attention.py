@@ -1,0 +1,268 @@
+"""Flash attention — a Pallas TPU kernel for the transformer hot op.
+
+The single-device analogue of :mod:`ops.ring_attention`: the same
+online-softmax recurrence, but blocked over VMEM within one chip instead of
+rotated around the ICI ring.  Q/K/V tiles stream HBM→VMEM per grid step and
+scores/normalizers never materialize in HBM — memory O(block²) instead of
+O(S²), the standard flash-attention scheme (Dao et al. 2205.14135) expressed
+in Pallas (see /opt/skills/guides/pallas_guide.md for the kernel idioms).
+
+Grid: ``(batch*heads, q_blocks, k_blocks)`` with the k dimension
+"arbitrary" (sequential) so the f32 scratch accumulators (m, l, acc)
+carry across k blocks of the same q block.
+
+Differentiation: the kernel is wrapped in ``jax.custom_vjp`` — forward runs
+the Pallas kernel and saves the per-query logsumexp; backward recomputes
+attention weights from the logsumexp with plain XLA einsums (numerically
+exact, O(S²) memory in the backward only).  On non-TPU backends the kernel
+runs in Pallas interpret mode, so the op is testable on the CPU mesh.
+
+``make_flash_attention()`` returns an ``attention_fn`` drop-in for
+``models.bert`` (same signature as ``dot_product_attention``).  The padding
+mask arrives as an additive f32 bias so the custom_vjp signature stays
+all-float.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pallas extras are absent on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_BIG = -1e30  # finite mask fill; -inf poisons the online-softmax max
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+            *, scale: float):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]  # [bq, D] — native dtype: bf16 inputs ride the MXU's
+    k = k_ref[0]  # bf16×bf16→f32 path; casting to f32 first would quarter
+    v = v_ref[0]  # the matmul rate
+    s = (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        * scale
+    )  # [bq, bk] f32
+    s = s + bias_ref[0, 0][None, :]  # additive key-padding bias (0 or NEG_BIG)
+
+    m_prev = m_ref[:, :1]  # [bq, 1]
+    m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_cur)
+    correction = jnp.exp(m_prev - m_cur)
+    l_new = l_ref[:, :1] * correction + p.sum(axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)  # fully-masked rows stay finite
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(l[:, 0])
+
+
+def _flash_fwd_pallas(q3, k3, v3, bias2, *, heads: int, block_q: int,
+                      block_k: int, out_dtype):
+    """q3/k3/v3: [BH, S, D]; bias2: [B, S] f32 → (o [BH,S,D], lse [BH,S])."""
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError("pallas TPU support unavailable in this jax build")
+    bh, s, d = q3.shape
+    scale = 1.0 / (d ** 0.5)
+    grid = (bh, s // block_q, s // block_k)
+
+    kernel = functools.partial(_kernel, scale=scale)
+    compiler_params = None
+    if not _use_interpret():
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    scratch = [
+        pltpu.VMEM((block_q, 128), jnp.float32),
+        pltpu.VMEM((block_q, 128), jnp.float32),
+        pltpu.VMEM((block_q, d), jnp.float32),
+    ]
+    # bias/lse ride as 3-D with a size-1 middle axis: TPU block shapes must
+    # have their last two dims divisible by (8, 128) or equal to the full
+    # array dims, and a full-size 1 satisfies that where a 1-of-B slice
+    # would not.
+    o3, lse3 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec(
+                (1, 1, block_k),
+                lambda b, qi, ki, heads=heads: (b // heads, 0, ki),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, qi, ki: (b, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), out_dtype),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        compiler_params=compiler_params,
+        interpret=_use_interpret(),
+    )(q3, k3, v3, bias2[:, None, :])
+    return o3, lse3[:, 0, :]
+
+
+def _make_core(heads: int, block_q: int, block_k: int, out_dtype):
+    @jax.custom_vjp
+    def core(q3, k3, v3, bias2):
+        o, _ = _flash_fwd_pallas(
+            q3, k3, v3, bias2, heads=heads, block_q=block_q,
+            block_k=block_k, out_dtype=out_dtype,
+        )
+        return o
+
+    def fwd(q3, k3, v3, bias2):
+        o, lse = _flash_fwd_pallas(
+            q3, k3, v3, bias2, heads=heads, block_q=block_q,
+            block_k=block_k, out_dtype=out_dtype,
+        )
+        return o, (q3, k3, v3, bias2, o, lse)
+
+    def bwd(res, do):
+        q3, k3, v3, bias2, o, lse = res
+        d = q3.shape[-1]
+        scale = 1.0 / (d ** 0.5)
+        qf = q3.astype(jnp.float32)
+        kf = k3.astype(jnp.float32)
+        vf = v3.astype(jnp.float32)
+        dof = do.astype(jnp.float32)
+        of = o.astype(jnp.float32)
+        bias_bh = jnp.repeat(bias2, heads, axis=0)  # [BH, S]
+        s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale + bias_bh[:, None, :]
+        p = jnp.exp(s - lse[..., None])  # exact weights from saved logsumexp
+        dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+        delta = jnp.sum(dof * of, axis=-1, keepdims=True)
+        ds = p * (dp - delta)
+        dq = jnp.einsum("bqk,bkd->bqd", ds, kf) * scale
+        dk = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
+        return (
+            dq.astype(q3.dtype),
+            dk.astype(k3.dtype),
+            dv.astype(v3.dtype),
+            jnp.zeros_like(bias2),
+        )
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array],
+    *,
+    dtype: jnp.dtype,
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jax.Array:
+    """Drop-in for ``models.bert.dot_product_attention``: [B, S, H, D] in/out.
+
+    ``mask``: bool, broadcastable to [B, 1, 1, S] (key padding).  Blocks
+    clamp to the sequence length; S must be divisible by the (clamped)
+    block sizes.
+    """
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(
+            f"seq len {s} not divisible by blocks ({block_q}, {block_k})"
+        )
+    if mask is None:
+        bias2 = jnp.zeros((b, s), jnp.float32)
+    else:
+        key_mask = jnp.broadcast_to(mask, (b, 1, 1, s))[:, 0, 0, :]
+        bias2 = jnp.where(key_mask, 0.0, NEG_BIG).astype(jnp.float32)
+
+    to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)  # noqa: E731
+    core = _make_core(h, block_q, block_k, dtype)
+    o3 = core(to3(q), to3(k), to3(v), bias2)
+    return o3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def make_flash_attention(block_q: int = 512, block_k: int = 512, mesh=None):
+    """Bind block sizes → an ``attention_fn`` for the transformer models.
+
+    With a multi-device ``mesh`` the kernel runs per-shard inside
+    ``shard_map`` — batch over the (data, fsdp) axes, heads over ``tensor``,
+    sequence replicated (sequence sharding is :func:`ops.ring_attention`'s
+    job).  A bare ``pallas_call`` cannot be partitioned by GSPMD, so without
+    this wrap a sharded caller would gather the global batch onto every chip.
+    """
+
+    def _local(q, k, v, mask, dtype):
+        return flash_attention(
+            q, k, v, mask, dtype=dtype, block_q=block_q, block_k=block_k
+        )
+
+    def attention_fn(q, k, v, mask, *, dtype):
+        if mesh is None or mesh.devices.size == 1:
+            return _local(q, k, v, mask, dtype)
+
+        from jax.sharding import PartitionSpec as P
+
+        from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
+
+        try:
+            from jax import shard_map as _shard_map
+
+            def shard_map(f, **kw):
+                # check_rep was renamed check_vma in jax>=0.8; the pallas
+                # call inside cannot annotate vma, so disable the check.
+                kw.pop("check_rep", None)
+                return _shard_map(f, check_vma=False, **kw)
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+
+        if mask is None:
+            mask = jnp.ones((q.shape[0], 1, 1, q.shape[1]), bool)
+        else:
+            mask = jnp.broadcast_to(
+                mask, (q.shape[0], 1, 1, q.shape[1])
+            )
+        qkv_spec = P(DATA_AXES, None, "tensor", None)
+        mask_spec = P(DATA_AXES, None, None, None)
+        return shard_map(
+            lambda q, k, v, m: _local(q, k, v, m, dtype),
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+            out_specs=qkv_spec,
+            check_rep=False,
+        )(q, k, v, mask)
+
+    return attention_fn

@@ -100,6 +100,7 @@ def main(
     fsdp: int = 1,
     tensor: int = 1,
     seq: int = 1,
+    attention: str = "auto",  # auto|default|flash|ring
     # model-size overrides (tiny configs for tests/smoke)
     num_layers: Optional[int] = None,
     hidden_size: Optional[int] = None,
@@ -170,8 +171,23 @@ def main(
     ):
         if value is not None:
             model_kwargs[key] = value
-    if seq > 1:
+    # Attention primitive selection: seq>1 requires the ring (the tokens are
+    # sharded over the seq axis); otherwise "flash" injects the Pallas
+    # blocked kernel (ops/flash_attention.py), "default" the fused XLA path.
+    if attention == "auto":
+        attention = "ring" if seq > 1 else "default"
+    if seq > 1 and attention != "ring":
+        raise ValueError(f"seq={seq} requires attention='ring', got {attention!r}")
+    if attention == "ring":
         model_kwargs["attention_fn"] = make_ring_attention(mesh)
+    elif attention == "flash":
+        from distributeddeeplearning_tpu.ops.flash_attention import (
+            make_flash_attention,
+        )
+
+        model_kwargs["attention_fn"] = make_flash_attention(mesh=mesh)
+    elif attention != "default":
+        raise ValueError(f"unknown attention mode {attention!r}")
     net = get_model(model, **model_kwargs)
 
     if tensor > 1:

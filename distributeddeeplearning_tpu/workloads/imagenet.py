@@ -43,7 +43,15 @@ def _batches(
     seed: Optional[int],
     synthetic_length: Optional[int] = None,
     augment: str = "reference",
+    input_pipeline: str = "tf",
 ) -> Iterator:
+    if input_pipeline == "native" and data_format != "tfrecords":
+        raise ValueError(
+            "input_pipeline='native' supports data_format='tfrecords' only "
+            f"(got {data_format!r})"
+        )
+    if input_pipeline not in ("tf", "native"):
+        raise ValueError(f"unknown input_pipeline {input_pipeline!r}")
     if data_format == "synthetic":
         import jax
 
@@ -73,6 +81,21 @@ def _batches(
             return epochs()
         return ds.batches(per_host_batch)
     if data_format == "tfrecords":
+        if input_pipeline == "native":
+            # The framework's own C reader + PIL/numpy path (TF-free);
+            # implements the reference recipe only.
+            if augment != "reference":
+                raise ValueError(
+                    "input_pipeline='native' supports augment='reference' only"
+                )
+            from distributeddeeplearning_tpu.data.native_pipeline import (
+                native_input_fn,
+            )
+
+            return native_input_fn(
+                data_path, is_training, per_host_batch,
+                image_size=image_size, seed=seed or 0, repeat=is_training,
+            )
         from distributeddeeplearning_tpu.data import tfrecords
 
         return tfrecords.input_fn(
@@ -115,6 +138,7 @@ def main(
     compute_dtype: str = "bfloat16",
     distributed: Optional[bool] = None,
     augment: str = "reference",  # "inception" = stronger train-time aug
+    input_pipeline: str = "tf",  # "native" = the framework's C reader + PIL
 ):
     """Train; returns (state, FitResult)."""
     import jax
@@ -168,7 +192,7 @@ def main(
     train_iter = _batches(
         data_format, training_data_path, True, per_host_batch,
         image_size, num_classes, seed, synthetic_length=n_train,
-        augment=augment,
+        augment=augment, input_pipeline=input_pipeline,
     )
     eval_factory = None
     if validation_data_path or data_format == "synthetic":
@@ -177,6 +201,7 @@ def main(
                 data_format, validation_data_path, False, per_host_batch,
                 image_size, num_classes, seed,
                 synthetic_length=min(n_train, 4 * global_batch),
+                input_pipeline=input_pipeline,
             )
 
     trainer = Trainer(

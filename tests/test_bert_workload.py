@@ -97,6 +97,15 @@ class TestBertFineTune:
         state, result = bert.main(**TINY, fsdp=2, tensor=2, seq=2)
         assert np.isfinite(result.final_train_metrics["loss"])
 
+    def test_fine_tune_with_flash_attention(self):
+        # The Pallas kernel (interpret mode on CPU) through the full driver.
+        state, result = bert.main(**TINY, attention="flash")
+        assert np.isfinite(result.final_train_metrics["loss"])
+
+    def test_seq_axis_rejects_non_ring_attention(self):
+        with pytest.raises(ValueError, match="requires attention='ring'"):
+            bert.main(**TINY, seq=2, attention="flash")
+
     def test_seq_len_divisibility_enforced(self):
         cfg = dict(TINY)
         cfg["seq_len"] = 10

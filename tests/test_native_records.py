@@ -1,0 +1,142 @@
+"""Native TFRecord reader (data/csrc/ddlt_records.c + data/_native.py).
+
+The framework's own native data-plane component — the role TensorFlow's
+C++ record reader plays in the reference.  Tests pin: CRC32C known answers,
+frame parity with tf.io.TFRecordWriter output, Example feature extraction
+against tf.train.Example serialization, corruption detection, and the
+pure-Python fallback agreeing with the C path.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.data import _native
+from distributeddeeplearning_tpu.data._native import (
+    RecordCorruptionError,
+    RecordReader,
+    crc32c,
+    example_bytes,
+    example_int64,
+    masked_crc32c,
+    native_available,
+)
+
+
+def _write_tfrecords(path, payloads):
+    import tensorflow as tf
+
+    with tf.io.TFRecordWriter(str(path)) as w:
+        for p in payloads:
+            w.write(p)
+
+
+def _example(jpeg: bytes, label: int) -> bytes:
+    import tensorflow as tf
+
+    return tf.train.Example(
+        features=tf.train.Features(
+            feature={
+                "image/encoded": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[jpeg])
+                ),
+                "image/class/label": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[label])
+                ),
+                "image/format": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[b"JPEG"])
+                ),
+            }
+        )
+    ).SerializeToString()
+
+
+def test_crc32c_known_answers():
+    # RFC 3720 test vector + empty string
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_native_library_compiles_here():
+    # This image ships cc; the C path must actually be exercised in CI.
+    assert native_available()
+
+
+def test_reader_matches_tf_writer(tmp_path):
+    payloads = [b"alpha", b"b" * 1000, b"", b"\x00\xff" * 7]
+    path = tmp_path / "t.tfrecord"
+    _write_tfrecords(path, payloads)
+    assert list(RecordReader(path)) == payloads
+    assert list(RecordReader(path, verify=False)) == payloads
+
+
+def test_reader_detects_corruption(tmp_path):
+    path = tmp_path / "c.tfrecord"
+    _write_tfrecords(path, [b"hello world records"])
+    raw = bytearray(path.read_bytes())
+    raw[14] ^= 0x01  # flip a payload byte
+    path.write_bytes(bytes(raw))
+    with pytest.raises(RecordCorruptionError):
+        list(RecordReader(path))
+    # verify=False trusts the frame lengths and yields the (corrupt) payload
+    assert len(list(RecordReader(path, verify=False))) == 1
+
+
+def test_reader_detects_truncation(tmp_path):
+    path = tmp_path / "t.tfrecord"
+    _write_tfrecords(path, [b"x" * 100])
+    raw = path.read_bytes()
+    path.write_bytes(raw[:-10])
+    with pytest.raises(RecordCorruptionError):
+        list(RecordReader(path, verify=False))
+
+
+def test_example_feature_extraction():
+    jpeg = b"\xff\xd8fakejpegdata\xff\xd9"
+    rec = _example(jpeg, 37)
+    assert example_bytes(rec, "image/encoded") == jpeg
+    assert example_bytes(rec, "image/format") == b"JPEG"
+    assert example_int64(rec, "image/class/label") == 37
+    assert example_bytes(rec, "missing/key") is None
+    assert example_int64(rec, "image/encoded") is None  # wrong kind
+
+
+def test_example_int64_negative_and_large():
+    import tensorflow as tf
+
+    for v in (-1, -12345, 2**40, 0):
+        rec = tf.train.Example(
+            features=tf.train.Features(
+                feature={
+                    "v": tf.train.Feature(
+                        int64_list=tf.train.Int64List(value=[v])
+                    )
+                }
+            )
+        ).SerializeToString()
+        assert example_int64(rec, "v") == v
+
+
+def test_python_fallback_agrees_with_native(tmp_path, monkeypatch):
+    payloads = [_example(b"data%d" % i, i) for i in range(5)]
+    path = tmp_path / "f.tfrecord"
+    _write_tfrecords(path, payloads)
+    native = list(RecordReader(path))
+
+    # Force the fallback by hiding the loaded library.
+    monkeypatch.setattr(_native, "_LIB", None)
+    monkeypatch.setattr(_native, "_TRIED", True)
+    assert not native_available()
+    fallback = list(RecordReader(path))
+    assert fallback == native == payloads
+    assert crc32c(b"123456789") == 0xE3069283  # pure-python table path
+    assert masked_crc32c(b"abc") == (
+        ((crc32c(b"abc") >> 15) | (crc32c(b"abc") << 17)) + 0xA282EAD8
+    ) & 0xFFFFFFFF
+    for i, rec in enumerate(fallback):
+        assert example_bytes(rec, "image/encoded") == b"data%d" % i
+        assert example_int64(rec, "image/class/label") == i
