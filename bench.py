@@ -39,7 +39,11 @@ V100_TF_CNN_BENCHMARKS_IMG_SEC = 720.0
 
 
 def _build_bench(args, devices=None):
-    """(step, state, batch, n_dev) for one mesh over ``devices``."""
+    """(step, state, batch, n_dev, parts) for one mesh over ``devices``.
+
+    ``parts`` carries (mesh, model, tx) so callers can mint additional
+    TrainStates whose static metadata (apply_fn, tx) matches the jitted
+    step — a state built from a NEW model/tx instance would not."""
     import jax
     import jax.numpy as jnp
 
@@ -71,7 +75,7 @@ def _build_bench(args, devices=None):
     )
     step = build_train_step(mesh, state, schedule=sched, compute_dtype=dtype)
     batch = shard_batch(mesh, synthetic_batch(global_batch, img_shape))
-    return step, state, batch, n_dev
+    return step, state, batch, n_dev, (mesh, model, tx)
 
 
 def _run_single(args) -> int:
@@ -83,7 +87,7 @@ def _run_single(args) -> int:
         step_flops,
     )
 
-    step, state, batch, n_dev = _build_bench(args)
+    step, state, batch, n_dev, (mesh, model, tx) = _build_bench(args)
     global_batch = args.batch_size * n_dev
 
     # Compile once up front (lowering does not consume the donated state) and
@@ -120,6 +124,60 @@ def _run_single(args) -> int:
         steps_per_sec = result.img_sec_total / global_batch
         mfu = flops * steps_per_sec / (n_dev * peak)
 
+    fit_img_sec = None
+    if args.fit:
+        # Same step, driven by Trainer.fit over a device-resident iterator:
+        # measures the training-loop machinery (metric accumulation, trackers)
+        # against the bare harness. The r01 loop lost ~2x here to a per-step
+        # host sync; the on-device accumulator must keep it within ~5%.
+        import itertools
+
+        from distributeddeeplearning_tpu.train.loop import (
+            Trainer,
+            TrainerConfig,
+        )
+
+        import jax as _jax
+
+        from distributeddeeplearning_tpu.train.state import create_train_state
+
+        # Fresh state with the SAME model/tx objects (identical pytree
+        # metadata) driven through the SAME jitted step — no recompile.
+        state2 = create_train_state(
+            _jax.random.key(1), model,
+            (args.batch_size, args.image_size, args.image_size, 3), tx,
+        )
+        batch2 = batch
+        steps = max(args.num_iters * args.num_batches_per_iter, 20)
+        trainer = Trainer(
+            mesh,
+            step,
+            config=TrainerConfig(
+                epochs=1,
+                steps_per_epoch=steps,
+                global_batch_size=global_batch,
+                log_every=10**9,  # end-of-epoch sync only, like the harness
+            ),
+        )
+        # Warm every jitted path the loop touches (train step reuse, the
+        # metric accumulator) with a short fit so the timed epoch measures
+        # steady state, not first-call compiles.
+        warm_state = create_train_state(
+            _jax.random.key(2), model,
+            (args.batch_size, args.image_size, args.image_size, 3), tx,
+        )
+        warm = Trainer(
+            mesh,
+            step,
+            config=TrainerConfig(
+                epochs=1, steps_per_epoch=3,
+                global_batch_size=global_batch, log_every=10**9,
+            ),
+        )
+        warm.fit(warm_state, itertools.repeat(batch2))
+        _, fit_result = trainer.fit(state2, itertools.repeat(batch2))
+        fit_img_sec = fit_result.images_per_second / n_dev
+
     line = {
         "metric": f"{args.model}_synthetic_train_img_sec_per_chip",
         "value": round(result.img_sec_per_chip_mean, 1),
@@ -132,6 +190,11 @@ def _run_single(args) -> int:
         line["mfu"] = round(mfu, 4)
     if flops is not None:
         line["step_gflops"] = round(flops / 1e9, 1)
+    if fit_img_sec is not None:
+        line["fit_img_sec_per_chip"] = round(fit_img_sec, 1)
+        line["fit_vs_harness"] = round(
+            fit_img_sec / result.img_sec_per_chip_mean, 3
+        )
     print(json.dumps(line))
     return 0
 
@@ -166,7 +229,9 @@ def _run_scaling(args) -> int:
             if args.trace_dir
             else contextlib.nullcontext()
         )
-        step, state, batch, n_dev = _build_bench(args, devices=jax.devices()[:n])
+        step, state, batch, n_dev, _parts = _build_bench(
+            args, devices=jax.devices()[:n]
+        )
         with trace:
             result = run_benchmark(
                 step,
@@ -215,6 +280,12 @@ def main() -> int:
     )
     parser.add_argument(
         "--fp32", action="store_true", help="disable bf16 compute"
+    )
+    parser.add_argument(
+        "--fit",
+        action="store_true",
+        help="also measure Trainer.fit throughput over the same step "
+        "(device-resident batches) and report fit_vs_harness",
     )
     parser.add_argument(
         "--devices",

@@ -326,6 +326,10 @@ def _py_find_len_field(buf: bytes, want: int, start: int = 0):
         field, wire = tag >> 3, tag & 7
         if field == want and wire == 2:
             n, pos = _py_varint(buf, pos)
+            if n > len(buf) - pos:
+                # Over-long field: the C path reports not-found rather than
+                # returning a truncated slice — mirror that exactly.
+                return None
             return buf[pos : pos + n]
         pos = _py_skip(buf, pos, wire)
     return None

@@ -142,18 +142,21 @@ static int read_varint(const uint8_t *p, uint64_t len, uint64_t *pos,
     return 0;
 }
 
-/* Skip a field of the given wire type; returns 1 on success. */
+/* Skip a field of the given wire type; returns 1 on success.
+ * Length checks use the subtraction form (v > len - *pos): a huge varint
+ * must not wrap the addition and slip past the bound. *pos <= len always
+ * holds, so the subtraction cannot underflow. */
 static int skip_field(const uint8_t *p, uint64_t len, uint64_t *pos,
                       uint32_t wire) {
     uint64_t v;
     switch (wire) {
     case 0: return read_varint(p, len, pos, &v);
-    case 1: if (*pos + 8 > len) return 0; *pos += 8; return 1;
+    case 1: if (8 > len - *pos) return 0; *pos += 8; return 1;
     case 2:
-        if (!read_varint(p, len, pos, &v) || *pos + v > len) return 0;
+        if (!read_varint(p, len, pos, &v) || v > len - *pos) return 0;
         *pos += v;
         return 1;
-    case 5: if (*pos + 4 > len) return 0; *pos += 4; return 1;
+    case 5: if (4 > len - *pos) return 0; *pos += 4; return 1;
     default: return 0;
     }
 }
@@ -169,7 +172,7 @@ static int find_len_field(const uint8_t *p, uint64_t len, uint32_t want_field,
         uint32_t field = (uint32_t)(tag >> 3), wire = (uint32_t)(tag & 7);
         if (field == want_field && wire == 2) {
             uint64_t n;
-            if (!read_varint(p, len, &pos, &n) || pos + n > len) return 0;
+            if (!read_varint(p, len, &pos, &n) || n > len - pos) return 0;
             *out = p + pos;
             *out_len = n;
             if (resume_pos) *resume_pos = pos + n;
@@ -232,8 +235,8 @@ int ddlt_example_int64(const uint8_t *ex, uint64_t ex_len, const char *key,
         if (field == 1 && wire == 2) {          /* packed */
             uint64_t n, v;
             if (!read_varint(ilist, ilist_len, &pos, &n)) return 0;
+            if (n > ilist_len - pos) return 0;  /* overflow-safe bound */
             uint64_t end = pos + n;
-            if (end > ilist_len) return 0;
             if (!read_varint(ilist, end, &pos, &v)) return 0;
             *out = (int64_t)v;
             return 1;

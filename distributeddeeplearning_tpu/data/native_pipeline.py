@@ -22,6 +22,7 @@ tf.data remains the default for its deeper prefetch pipeline.
 from __future__ import annotations
 
 import random
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Iterator, Optional
 
@@ -147,7 +148,7 @@ def native_input_fn(
             images, labels = [], []
             # Window the decode fan-out so at most ~4 batches are in flight.
             window = max(batch_size * 4, num_workers)
-            pending = []
+            pending = deque()
             if is_training and shuffle_buffer > 1:
                 record_iter = _shuffled_records(
                     order, rng, shuffle_buffer, verify=verify_crc
@@ -164,7 +165,7 @@ def native_input_fn(
                     pending.append(pool.submit(one, rec))
                 if not pending:
                     break
-                image, label = pending.pop(0).result()
+                image, label = pending.popleft().result()
                 images.append(image)
                 labels.append(label)
                 if len(images) == batch_size:

@@ -46,6 +46,11 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     dropout_rate: float = 0.1
     num_classes: int = 2  # sequence-classification head (fine-tune target)
+    # Mixture-of-Experts: >0 replaces the dense FFN with models.moe.MoeMlp
+    # in every ``moe_every_n``-th layer (GShard convention: every 2nd).
+    num_experts: int = 0
+    moe_every_n: int = 2
+    moe_capacity_factor: float = 1.25
 
 
 BERT_BASE = BertConfig()
@@ -130,6 +135,7 @@ class EncoderLayer(nn.Module):
     config: BertConfig
     dtype: jnp.dtype = jnp.bfloat16
     attention_fn: AttentionFn = dot_product_attention
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, mask, train: bool):
@@ -144,9 +150,20 @@ class EncoderLayer(nn.Module):
                          param_dtype=jnp.float32, name="attention_ln")(x + attn)
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
-        h = _dense(cfg.intermediate_size, ("embed", "mlp"), self.dtype, "mlp_in")(x)
-        h = nn.gelu(h, approximate=False)
-        h = _dense(cfg.hidden_size, ("mlp", "embed"), self.dtype, "mlp_out")(h)
+        if self.use_moe:
+            from distributeddeeplearning_tpu.models.moe import MoeMlp
+
+            h = MoeMlp(
+                num_experts=cfg.num_experts,
+                intermediate_size=cfg.intermediate_size,
+                capacity_factor=cfg.moe_capacity_factor,
+                dtype=self.dtype,
+                name="moe_mlp",
+            )(x, train)
+        else:
+            h = _dense(cfg.intermediate_size, ("embed", "mlp"), self.dtype, "mlp_in")(x)
+            h = nn.gelu(h, approximate=False)
+            h = _dense(cfg.hidden_size, ("mlp", "embed"), self.dtype, "mlp_out")(h)
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
@@ -219,9 +236,13 @@ class BertEncoder(nn.Module):
             mask = attention_mask[:, None, None, :].astype(bool)
 
         for i in range(cfg.num_layers):
-            x = EncoderLayer(cfg, self.dtype, self.attention_fn, name=f"layer{i}")(
-                x, mask, train
+            use_moe = (
+                cfg.num_experts > 0 and (i + 1) % max(cfg.moe_every_n, 1) == 0
             )
+            x = EncoderLayer(
+                cfg, self.dtype, self.attention_fn, use_moe=use_moe,
+                name=f"layer{i}",
+            )(x, mask, train)
 
         # pooler: tanh(dense(CLS)) then classification head
         cls = x[:, 0]

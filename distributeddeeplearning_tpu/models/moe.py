@@ -1,0 +1,156 @@
+"""Mixture-of-Experts MLP with expert parallelism over the ``expert`` axis.
+
+The reference has no MoE (CNNs + Horovod DP only — SURVEY.md §2 "Expert
+parallelism: Absent"); this layer is part of the framework's
+beyond-reference parallelism surface, giving the ``expert`` mesh axis
+(``parallel/mesh.py``) a first-class consumer.
+
+TPU-first design (GShard/Switch style, dense dispatch einsums — no gather/
+scatter, fully static shapes, MXU-friendly):
+
+- router: fp32 softmax over experts, top-k (default 2) gate selection with
+  renormalized gates;
+- capacity: each expert takes at most ``ceil(k·N/E · capacity_factor)``
+  tokens; overflow tokens are dropped from that expert (their residual
+  connection still carries the activation — standard Switch behavior);
+- dispatch/combine as one-hot einsums: ``[N,E,C]`` tensors contract tokens
+  into per-expert batches ``[E,C,H]`` and back.  Under a sharded ``expert``
+  axis XLA turns these contractions into the all-to-all that defines
+  expert parallelism;
+- expert FFNs are ONE pair of stacked weights ``[E,H,M]``/``[E,M,H]`` with
+  logical axes ``("expert", …)`` so ``RULES_EP`` shards them across the
+  ``expert`` mesh axis (``parallel/sharding.py``);
+- load-balance auxiliary loss (Switch eq. 4): ``E · Σ_e f_e · p_e`` sown
+  into the ``moe_losses`` collection; ``train.step`` adds it to the task
+  loss with ``moe_aux_weight``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+MOE_LOSS_COLLECTION = "moe_losses"
+
+
+class MoeMlp(nn.Module):
+    """Drop-in for a transformer FFN block: [B, S, H] → [B, S, H]."""
+
+    num_experts: int
+    intermediate_size: int
+    capacity_factor: float = 1.25
+    router_top_k: int = 2
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        b, s, hidden = x.shape
+        n = b * s
+        e = self.num_experts
+        k = min(self.router_top_k, e)
+        capacity = max(int(math.ceil(k * n / e * self.capacity_factor)), 1)
+
+        xf = x.reshape(n, hidden)
+
+        # Router in fp32: gate quality is precision-sensitive.
+        router_logits = nn.Dense(
+            e,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+            use_bias=False,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("embed", "expert")
+            ),
+            name="router",
+        )(xf.astype(jnp.float32))
+        probs = jax.nn.softmax(router_logits, axis=-1)  # [n, e]
+
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [n, k]
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9
+        )
+
+        # Slot-by-slot position assignment (k is 1 or 2 — static unroll).
+        combine = jnp.zeros((n, e, capacity), jnp.float32)
+        counts = jnp.zeros((e,), jnp.int32)  # tokens accepted per expert
+        for j in range(k):
+            onehot = jax.nn.one_hot(gate_idx[:, j], e, dtype=jnp.int32)
+            # tokens of this slot queued before each token, per expert
+            before = jnp.cumsum(onehot, axis=0) - onehot
+            pos = (before * onehot).sum(-1) + (counts[None, :] * onehot).sum(-1)
+            keep = pos < capacity
+            combine = combine + (
+                gate_vals[:, j, None, None]
+                * onehot[:, :, None]
+                * jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :]
+                * keep[:, None, None]
+            )
+            counts = counts + (onehot * keep[:, None]).sum(0)
+
+        dispatch = (combine > 0).astype(self.dtype)  # [n, e, c]
+
+        expert_in = jnp.einsum(
+            "nec,nh->ech", dispatch, xf.astype(self.dtype)
+        )  # [e, c, h]
+
+        w_in = self.param(
+            "w_in",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("expert", "embed", "mlp")
+            ),
+            (e, hidden, self.intermediate_size),
+            jnp.float32,
+        )
+        b_in = self.param(
+            "b_in",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros, ("expert", "mlp")
+            ),
+            (e, self.intermediate_size),
+            jnp.float32,
+        )
+        w_out = self.param(
+            "w_out",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("expert", "mlp", "embed")
+            ),
+            (e, self.intermediate_size, hidden),
+            jnp.float32,
+        )
+        b_out = self.param(
+            "b_out",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros, ("expert", "embed")
+            ),
+            (e, hidden),
+            jnp.float32,
+        )
+
+        h = jnp.einsum(
+            "ech,ehm->ecm", expert_in, w_in.astype(self.dtype)
+        ) + b_in[:, None, :].astype(self.dtype)
+        h = nn.gelu(h, approximate=False)
+        out = jnp.einsum(
+            "ecm,emh->ech", h, w_out.astype(self.dtype)
+        ) + b_out[:, None, :].astype(self.dtype)
+
+        y = jnp.einsum(
+            "nec,ech->nh", combine.astype(self.dtype), out
+        )
+
+        if train:
+            # Switch load-balance loss: e · Σ_e f_e p_e — minimized (=1)
+            # at a uniform router.  f uses top-1 assignment fractions.
+            top1 = jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32)
+            f = top1.mean(0)
+            p = probs.mean(0)
+            self.sow(
+                MOE_LOSS_COLLECTION,
+                "load_balance",
+                e * jnp.sum(f * p),
+            )
+        return y.reshape(b, s, hidden)

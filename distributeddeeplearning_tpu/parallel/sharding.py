@@ -50,6 +50,14 @@ def shard_batch(mesh: Mesh, batch: PyTree) -> PyTree:
     (SURVEY.md §7 "Hard parts" (a)).
     """
     sharding = batch_sharding(mesh)
+    leaves = jax.tree_util.tree_leaves(batch)
+    if leaves and all(
+        isinstance(x, jax.Array) and x.sharding == sharding for x in leaves
+    ):
+        # Already placed (e.g. a device-resident benchmark batch): skip the
+        # no-op device_put — its dispatch is not free, especially on
+        # remote/tunneled backends.
+        return batch
     if jax.process_count() == 1:
         return jax.device_put(batch, sharding)
     return jax.tree_util.tree_map(
@@ -81,6 +89,13 @@ RULES_TP: Sequence[Tuple[str, Optional[str]]] = [
     ("heads", "tensor"),
     ("kv", "tensor"),
     ("embed", "fsdp"),
+]
+
+RULES_EP: Sequence[Tuple[str, Optional[str]]] = [
+    # Expert parallelism: stacked MoE expert weights [E, ...] split across
+    # the expert mesh axis; compose with a base rule set, e.g.
+    # ``list(RULES_FSDP) + list(RULES_EP)``.
+    ("expert", "expert"),
 ]
 
 

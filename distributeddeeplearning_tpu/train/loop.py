@@ -35,6 +35,17 @@ from distributeddeeplearning_tpu.utils.throughput import ExamplesPerSecondTracke
 
 logger = logging.getLogger("ddlt.train")
 
+
+def jnp_add(a, b):
+    return a + b
+
+
+# One jitted dispatch per step for the metric accumulation instead of one
+# per metric: per-dispatch latency is material on remote backends, and this
+# runs every hot-loop step.  Module-level so the compiled executable is
+# shared across Trainer instances and epochs.
+_acc_add = jax.jit(lambda a, b: jax.tree.map(jnp_add, a, b))
+
 Batch = Dict[str, np.ndarray]
 
 
@@ -74,6 +85,11 @@ class TrainerConfig:
     tensorboard_dir: Optional[str] = None
     resume: bool = True
     max_to_keep: int = 5
+    # jax.profiler trace of a step window (primary process only): steps
+    # [profile_start, profile_start + profile_steps) of the first epoch run.
+    profile_dir: Optional[str] = None
+    profile_start: int = 10  # skip compile + warmup steps
+    profile_steps: int = 10
 
 
 @dataclasses.dataclass
@@ -140,6 +156,16 @@ class Trainer:
         train_metrics: Dict[str, float] = {}
         eval_metrics: Optional[Dict[str, float]] = None
         epoch = start_epoch
+        profile_active = False
+        profile_pending = cfg.profile_dir is not None and is_primary()
+        total_steps = (cfg.epochs - start_epoch) * cfg.steps_per_epoch
+        if profile_pending and total_steps <= cfg.profile_start:
+            logger.warning(
+                "profile_dir set but the run has only %d steps (< profile_start"
+                " %d) — starting the trace at step 0 instead",
+                total_steps, cfg.profile_start,
+            )
+        global_step = 0
 
         for epoch in range(start_epoch, cfg.epochs):
             # Metrics accumulate ON DEVICE (one tiny async add per step);
@@ -148,17 +174,26 @@ class Trainer:
             # gap between Trainer.fit and the benchmark harness throughput.
             acc = None
             for step_i in range(cfg.steps_per_epoch):
+                if profile_pending and global_step >= min(
+                    cfg.profile_start, max(total_steps - 1, 0)
+                ):
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profile_active, profile_pending = True, False
                 batch = shard_batch(self.mesh, next(train_batches))
                 state, metrics = self.train_step(state, batch)
-                acc = (
-                    metrics
-                    if acc is None
-                    else jax.tree.map(lambda a, b: a + b, acc, metrics)
-                )
+                acc = metrics if acc is None else _acc_add(acc, metrics)
                 if (step_i + 1) % cfg.log_every == 0:
                     jax.block_until_ready(acc)
                 tracker.after_step()
                 total_images += cfg.global_batch_size
+                global_step += 1
+                if profile_active and global_step >= (
+                    cfg.profile_start + cfg.profile_steps
+                ):
+                    jax.block_until_ready(acc)
+                    jax.profiler.stop_trace()
+                    profile_active = False
+                    logger.info("profiler trace written to %s", cfg.profile_dir)
             train_metrics = {
                 k: float(v) / cfg.steps_per_epoch for k, v in acc.items()
             }
@@ -184,6 +219,8 @@ class Trainer:
             if self.checkpointer is not None:
                 self.checkpointer.save((epoch + 1) * cfg.steps_per_epoch, state)
 
+        if profile_active:  # run shorter than the requested window
+            jax.profiler.stop_trace()
         wall = time.monotonic() - train_t0
         self.tb.flush()
         if self.checkpointer is not None:

@@ -85,7 +85,14 @@ def _cast_inputs(inputs: jax.Array, compute_dtype: jnp.dtype) -> jax.Array:
 
 
 def _forward(state, params, inputs, train: bool, rngs=None, extras=None):
-    """Apply the model, handling BN batch_stats models and stat-free models."""
+    """Apply the model, handling BN batch_stats models and stat-free models.
+
+    Returns (logits, new_batch_stats, aux_loss) where ``aux_loss`` is the
+    summed ``moe_losses`` collection (0.0 for models without MoE layers) —
+    the Switch-style load-balance terms sown by ``models.moe.MoeMlp``.
+    """
+    from distributeddeeplearning_tpu.models.moe import MOE_LOSS_COLLECTION
+
     has_stats = bool(jax.tree_util.tree_leaves(state.batch_stats))
     variables = {"params": params}
     kwargs = dict(extras or {})
@@ -93,14 +100,22 @@ def _forward(state, params, inputs, train: bool, rngs=None, extras=None):
         kwargs["rngs"] = rngs
     if has_stats:
         variables["batch_stats"] = state.batch_stats
-        if train:
-            logits, new_vars = state.apply_fn(
-                variables, inputs, train=True, mutable=["batch_stats"], **kwargs
+    if train:
+        mutable = [MOE_LOSS_COLLECTION] + (["batch_stats"] if has_stats else [])
+        logits, new_vars = state.apply_fn(
+            variables, inputs, train=True, mutable=mutable, **kwargs
+        )
+        aux = sum(
+            jnp.sum(leaf)
+            for leaf in jax.tree_util.tree_leaves(
+                new_vars.get(MOE_LOSS_COLLECTION, {})
             )
-            return logits, new_vars["batch_stats"]
-        kwargs.pop("rngs", None)
-        return state.apply_fn(variables, inputs, train=False, **kwargs), state.batch_stats
-    return state.apply_fn(variables, inputs, train=train, **kwargs), state.batch_stats
+        )
+        new_stats = new_vars.get("batch_stats", state.batch_stats)
+        return logits, new_stats, jnp.asarray(aux, jnp.float32)
+    kwargs.pop("rngs", None)
+    logits = state.apply_fn(variables, inputs, train=False, **kwargs)
+    return logits, state.batch_stats, jnp.zeros((), jnp.float32)
 
 
 def _state_shardings(mesh, state_example, rules, logical_axes):
@@ -146,6 +161,7 @@ def build_train_step(
     logical_axes: Optional[PyTree] = None,
     loss_fn: Callable = cross_entropy_loss,
     rng: Optional[jax.Array] = None,
+    moe_aux_weight: float = 0.01,  # Switch Transformer's α
 ) -> Callable:
     """Compile the full DP training step over ``mesh``.
 
@@ -170,7 +186,7 @@ def build_train_step(
         rngs = {"dropout": jax.random.fold_in(base_rng, state.step)}
 
         def compute_loss(params):
-            logits, new_stats = _forward(
+            logits, new_stats, aux = _forward(
                 state,
                 params,
                 _cast_inputs(inputs, compute_dtype),
@@ -179,6 +195,7 @@ def build_train_step(
                 extras=extras,
             )
             loss = loss_fn(logits, labels, label_smoothing=label_smoothing)
+            loss = loss + moe_aux_weight * aux
             return loss, (logits, new_stats)
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
@@ -221,7 +238,7 @@ def build_eval_step(
         inputs = batch.get("image", batch.get("input"))
         labels = batch["label"]
         extras = {k: batch[k] for k in EXTRA_INPUT_KEYS if k in batch}
-        logits, _ = _forward(
+        logits, _, _ = _forward(
             state,
             state.params,
             _cast_inputs(inputs, compute_dtype),

@@ -93,6 +93,7 @@ def main(
     save_filepath: Optional[str] = None,
     tensorboard_dir: Optional[str] = None,
     resume: bool = True,
+    profile_dir: Optional[str] = None,  # jax.profiler trace of steps 10-20
     seed: int = 42,
     compute_dtype: str = "bfloat16",
     distributed: Optional[bool] = None,
@@ -100,7 +101,9 @@ def main(
     fsdp: int = 1,
     tensor: int = 1,
     seq: int = 1,
+    expert: int = 1,
     attention: str = "auto",  # auto|default|flash|ring
+    num_experts: int = 0,  # >0 = MoE FFN in every 2nd layer (models/moe.py)
     # model-size overrides (tiny configs for tests/smoke)
     num_layers: Optional[int] = None,
     hidden_size: Optional[int] = None,
@@ -122,6 +125,7 @@ def main(
     )
     from distributeddeeplearning_tpu.parallel.sharding import (
         RULES_DP,
+        RULES_EP,
         RULES_FSDP,
         RULES_TP,
         model_logical_axes,
@@ -136,8 +140,14 @@ def main(
         build_train_step,
     )
 
+    if expert > 1 and num_experts == 0:
+        raise ValueError("expert-axis sharding needs --num_experts > 0")
+    if num_experts and expert > 1 and num_experts % expert != 0:
+        raise ValueError(
+            f"num_experts {num_experts} not divisible by expert axis {expert}"
+        )
     ctx = initialize(force=distributed)
-    mesh = create_mesh(MeshSpec(fsdp=fsdp, tensor=tensor, seq=seq))
+    mesh = create_mesh(MeshSpec(fsdp=fsdp, tensor=tensor, seq=seq, expert=expert))
     world = mesh.devices.size
     batch_shards = mesh.shape["data"] * mesh.shape["fsdp"]
     global_batch = batch_size * batch_shards
@@ -162,6 +172,8 @@ def main(
         dropout_rate=dropout_rate,
         dtype=dtype,
     )
+    if num_experts:
+        model_kwargs["num_experts"] = num_experts
     for key, value in (
         ("num_layers", num_layers),
         ("hidden_size", hidden_size),
@@ -196,6 +208,9 @@ def main(
         rules = RULES_FSDP
     else:
         rules = RULES_DP
+    if num_experts:
+        # expert weights [E, ...] shard over the expert axis (no-op at size 1)
+        rules = list(rules) + list(RULES_EP)
     if seq_len % max(seq, 1) != 0:
         raise ValueError(f"seq_len {seq_len} not divisible by seq axis {seq}")
     # Init/trace shapes must divide the mesh axes the ring-attention
@@ -246,6 +261,7 @@ def main(
             checkpoint_dir=save_filepath,
             tensorboard_dir=tensorboard_dir,
             resume=resume,
+            profile_dir=profile_dir,
         ),
     )
     return trainer.fit(state, train_iter, eval_factory)

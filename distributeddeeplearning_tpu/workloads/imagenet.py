@@ -139,6 +139,7 @@ def main(
     distributed: Optional[bool] = None,
     augment: str = "reference",  # "inception" = stronger train-time aug
     input_pipeline: str = "tf",  # "native" = the framework's C reader + PIL
+    profile_dir: Optional[str] = None,  # jax.profiler trace of steps 10-20
 ):
     """Train; returns (state, FitResult)."""
     import jax
@@ -215,6 +216,7 @@ def main(
             checkpoint_dir=save_filepath,
             tensorboard_dir=tensorboard_dir,
             resume=resume,
+            profile_dir=profile_dir,
         ),
     )
     return trainer.fit(state, train_iter, eval_factory)

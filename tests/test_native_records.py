@@ -121,6 +121,22 @@ def test_example_int64_negative_and_large():
         assert example_int64(rec, "v") == v
 
 
+def test_overlong_field_lengths_rejected_not_overread():
+    """A length-delimited field whose varint length is near 2^64 (or just
+    past the buffer) must read as not-found on BOTH paths — never an
+    out-of-bounds slice (C) or truncated garbage (Python)."""
+    # field 1 (Example.features), wire 2, length = 2^64-1 (10-byte varint)
+    huge = bytes([0x0A]) + b"\xff" * 9 + b"\x01"
+    assert example_bytes(huge, "image/encoded") is None
+    assert example_int64(huge, "image/class/label") is None
+    # plausible-but-overlong: claims 100 bytes, buffer has 4
+    overlong = bytes([0x0A, 100]) + b"abcd"
+    assert example_bytes(overlong, "image/encoded") is None
+    # same through the pure-Python walkers
+    assert _native._py_find_len_field(huge, 1) is None
+    assert _native._py_find_len_field(overlong, 1) is None
+
+
 def test_python_fallback_agrees_with_native(tmp_path, monkeypatch):
     payloads = [_example(b"data%d" % i, i) for i in range(5)]
     path = tmp_path / "f.tfrecord"
