@@ -159,12 +159,14 @@ class Trainer:
         profile_active = False
         profile_pending = cfg.profile_dir is not None and is_primary()
         total_steps = (cfg.epochs - start_epoch) * cfg.steps_per_epoch
+        profile_start = cfg.profile_start
         if profile_pending and total_steps <= cfg.profile_start:
             logger.warning(
                 "profile_dir set but the run has only %d steps (< profile_start"
                 " %d) — starting the trace at step 0 instead",
                 total_steps, cfg.profile_start,
             )
+            profile_start = 0
         global_step = 0
 
         for epoch in range(start_epoch, cfg.epochs):
@@ -174,9 +176,7 @@ class Trainer:
             # gap between Trainer.fit and the benchmark harness throughput.
             acc = None
             for step_i in range(cfg.steps_per_epoch):
-                if profile_pending and global_step >= min(
-                    cfg.profile_start, max(total_steps - 1, 0)
-                ):
+                if profile_pending and global_step >= profile_start:
                     jax.profiler.start_trace(cfg.profile_dir)
                     profile_active, profile_pending = True, False
                 batch = shard_batch(self.mesh, next(train_batches))
@@ -188,12 +188,19 @@ class Trainer:
                 total_images += cfg.global_batch_size
                 global_step += 1
                 if profile_active and global_step >= (
-                    cfg.profile_start + cfg.profile_steps
+                    profile_start + cfg.profile_steps
                 ):
                     jax.block_until_ready(acc)
                     jax.profiler.stop_trace()
                     profile_active = False
                     logger.info("profiler trace written to %s", cfg.profile_dir)
+            if profile_active:
+                # Run shorter than the window: close the trace on step work
+                # only — eval/checkpoint/TB below must not pollute it.
+                jax.block_until_ready(acc)
+                jax.profiler.stop_trace()
+                profile_active = False
+                logger.info("profiler trace written to %s", cfg.profile_dir)
             train_metrics = {
                 k: float(v) / cfg.steps_per_epoch for k, v in acc.items()
             }
@@ -219,8 +226,6 @@ class Trainer:
             if self.checkpointer is not None:
                 self.checkpointer.save((epoch + 1) * cfg.steps_per_epoch, state)
 
-        if profile_active:  # run shorter than the requested window
-            jax.profiler.stop_trace()
         wall = time.monotonic() - train_t0
         self.tb.flush()
         if self.checkpointer is not None:
