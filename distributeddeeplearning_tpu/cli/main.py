@@ -81,8 +81,16 @@ def _add_submit_tree(sub, workload: str, formats=DATA_FORMATS) -> None:
             for fmt in formats:
                 fmt_p = fmt_sub.add_parser(fmt, help=f"{fmt} input data")
                 fmt_p.add_argument("--experiment", default=None)
+                if mode == "remote":
+                    fmt_p.add_argument(
+                        "--max-retries", type=int, default=None,
+                        help="Recreate the pod and resubmit on preemption "
+                        "(default: MAX_RETRIES setting, 0)",
+                    )
         else:
             mode_p.add_argument("--experiment", default=None)
+            if mode == "remote":
+                mode_p.add_argument("--max-retries", type=int, default=None)
 
 
 def _global_flags(parser, suppress: bool = False) -> None:
@@ -263,7 +271,8 @@ def _submit(args, workload: str, extra: List[str]) -> int:
         )
     else:
         run = submitter.submit_remote(
-            workload, params, experiment=args.experiment
+            workload, params, experiment=args.experiment,
+            max_retries=getattr(args, "max_retries", None),
         )
     print(f"run {run.experiment}/{run.run_id}: {run.status}")
     return 0 if run.status == "completed" or args.dry_run else 1

@@ -171,8 +171,20 @@ class Submitter:
         experiment: Optional[str] = None,
         pod: Optional[TpuPod] = None,
         python: str = "python3",
+        max_retries: Optional[int] = None,
+        project_dir: str = ".",
     ) -> Run:
-        """Get-or-create the pod, fan the launcher out over all workers."""
+        """Get-or-create the pod, fan the launcher out over all workers.
+
+        ``max_retries`` (default from ``MAX_RETRIES`` setting, 0) adds the
+        preemption handling both the reference and plain Horovod lack
+        (SURVEY.md §5 "Failure detection… None in-repo"): when the launch
+        fails and the pod is gone or not READY — the preemptible-TPU
+        signature — the pod is recreated and the identical command resent.
+        Checkpoints live in the run's GCS dir and the workloads default to
+        ``resume=True``, so a retried run continues from the last epoch
+        rather than restarting.
+        """
         params = self._resolve_params(params, "remote")
         experiment = experiment or self.settings.get("EXPERIMENT_NAME", "experiment")
         pod = pod or pod_from_settings(self.settings, self.runner)
@@ -202,8 +214,39 @@ class Submitter:
         import shlex
 
         command = shlex.join(argv)
+        if max_retries is None:
+            max_retries = int(self.settings.get("MAX_RETRIES", "0") or 0)
         self.registry.update(run, status="running")
-        result = pod.ssh(command, worker="all", env=env)
+        result = pod.ssh(command, worker="all", env=env, check=False)
+        attempts = 1
+        while not result.ok and attempts <= max_retries:
+            state = pod.state()
+            if state == "READY":
+                # The pod is healthy: the failure is the workload's, not a
+                # preemption — retrying the same code would fail the same way.
+                logger.error(
+                    "run %s failed with pod READY; not retrying", run.run_id
+                )
+                break
+            logger.warning(
+                "run %s attempt %d failed (pod state %s) — recreating pod "
+                "and resubmitting (%d/%d)",
+                run.run_id, attempts, state, attempts, max_retries,
+            )
+            pod.recreate()
+            # Fresh VMs have nothing installed: re-run the bootstrap (scp +
+            # pip install) or the identical resubmit dies on import.
+            self.bootstrap_pod(project_dir, pod=pod)
+            result = pod.ssh(command, worker="all", env=env, check=False)
+            attempts += 1
+        if not result.ok:
+            tail = (result.stderr or result.stdout or "").strip()[-2000:]
+            logger.error(
+                "remote run %s failed (rc=%d)%s",
+                run.run_id,
+                result.returncode,
+                f":\n{tail}" if tail else "",
+            )
         self.registry.update(
             run,
             status="completed" if result.ok else "failed",

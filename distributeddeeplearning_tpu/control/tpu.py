@@ -103,6 +103,20 @@ class TpuPod:
     def exists(self) -> bool:
         return self.describe() is not None
 
+    def state(self) -> Optional[str]:
+        """Lifecycle state from the API (READY, PREEMPTED, TERMINATED, …);
+        None when the pod does not exist."""
+        meta = self.describe()
+        if meta is None:
+            return None
+        return meta.get("state", "UNKNOWN")
+
+    def recreate(self) -> None:
+        """Delete + create — the preemption-recovery primitive."""
+        logger.warning("recreating TPU %s", self.name)
+        self.delete()
+        self.create()
+
     def create(self) -> bool:
         """Get-or-create; returns True when a pod was actually created.
 
@@ -137,6 +151,7 @@ class TpuPod:
         *,
         worker: str = "all",
         env: Optional[Dict[str, str]] = None,
+        check: bool = True,
     ):
         """Run ``command`` on pod workers — the per-host launcher fan-out
         that replaces ``mpirun`` (``aml_compute.py:128`` distributed_backend).
@@ -154,7 +169,8 @@ class TpuPod:
             command = f"export {exports} && {command}"
         return self.runner.run(
             self._base("ssh", self.name)
-            + ["--zone", self.zone, "--worker", str(worker), "--command", command]
+            + ["--zone", self.zone, "--worker", str(worker), "--command", command],
+            check=check,
         )
 
     def scp(self, src: str, dst: str, *, worker: str = "all"):

@@ -167,9 +167,15 @@ class InceptionAux(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool):
-        x = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
+        # VALID windows match the canonical 299-input geometry (17×17 grid →
+        # 5×5 pool → 1×1 conv); smaller inputs would collapse to 0-sized
+        # dims and NaN — both stages fall back to SAME there (static shapes,
+        # so the choice resolves at trace time).
+        pool_pad = "VALID" if min(x.shape[1], x.shape[2]) >= 5 else "SAME"
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3), padding=pool_pad)
         x = ConvBN(128, 1, dtype=self.dtype)(x, train)
-        x = ConvBN(768, 5, padding="VALID", dtype=self.dtype)(x, train)
+        conv_pad = "VALID" if min(x.shape[1], x.shape[2]) >= 5 else "SAME"
+        x = ConvBN(768, 5, padding=conv_pad, dtype=self.dtype)(x, train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(
             self.num_classes, dtype=self.dtype, param_dtype=jnp.float32,

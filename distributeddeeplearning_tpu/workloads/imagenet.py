@@ -140,6 +140,7 @@ def main(
     augment: str = "reference",  # "inception" = stronger train-time aug
     input_pipeline: str = "tf",  # "native" = the framework's C reader + PIL
     profile_dir: Optional[str] = None,  # jax.profiler trace of steps 10-20
+    aux_logits: bool = False,  # InceptionV3 aux head, loss weighted 0.4
 ):
     """Train; returns (state, FitResult)."""
     import jax
@@ -176,7 +177,18 @@ def main(
             model, world, global_batch, spe, epochs,
         )
 
-    net = get_model(model, num_classes=num_classes, dtype=dtype)
+    model_kwargs = {}
+    loss_fn = None
+    if aux_logits:
+        if "inception" not in model:
+            raise ValueError("--aux_logits is an InceptionV3 option")
+        from distributeddeeplearning_tpu.models.inception import (
+            inception_aux_loss,
+        )
+
+        model_kwargs["aux_logits"] = True
+        loss_fn = inception_aux_loss
+    net = get_model(model, num_classes=num_classes, dtype=dtype, **model_kwargs)
     schedule = goyal_lr_schedule(
         base_lr, world, spe, warmup_epochs=warmup_epochs
     )
@@ -184,9 +196,10 @@ def main(
     state = create_train_state(
         jax.random.key(seed), net, (1, image_size, image_size, 3), tx
     )
+    step_kwargs = {"loss_fn": loss_fn} if loss_fn is not None else {}
     train_step = build_train_step(
         mesh, state, schedule=schedule, label_smoothing=label_smoothing,
-        compute_dtype=dtype, rng=jax.random.key(seed + 1),
+        compute_dtype=dtype, rng=jax.random.key(seed + 1), **step_kwargs,
     )
     eval_step = build_eval_step(mesh, state, compute_dtype=dtype)
 

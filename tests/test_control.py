@@ -244,6 +244,94 @@ class TestSubmitter:
         assert run.status == "completed" and run.mode == "remote"
         assert registry.runs("exp1")[0].run_id == run.run_id
 
+    def _preemption_runner(self, *, pod_state: str, fail_ssh_times: int):
+        """ssh fails ``fail_ssh_times`` times then succeeds; describe
+        reports ``pod_state``."""
+        counters = {"ssh": 0}
+
+        def ssh_fails(argv):
+            # count only workload launches; bootstrap's pip-install ssh and
+            # scp must succeed
+            if "ssh" not in argv or not any("workloads." in a for a in argv):
+                return False
+            counters["ssh"] += 1
+            return counters["ssh"] <= fail_ssh_times
+
+        def describe(argv):
+            return "describe" in argv
+
+        return FakeRunner(
+            [
+                (ssh_fails, CommandResult([], returncode=255)),
+                (
+                    describe,
+                    CommandResult(
+                        [], returncode=0,
+                        stdout='{"state": "%s"}' % pod_state,
+                    ),
+                ),
+            ]
+        )
+
+    def test_remote_retries_on_preemption(self, submit_env):
+        """Failed launch + non-READY pod → recreate + resubmit, then the
+        run completes (the preemption handling the reference lacks)."""
+        cfg, _, registry = submit_env
+        runner = self._preemption_runner(
+            pod_state="PREEMPTED", fail_ssh_times=1
+        )
+        submitter = Submitter(cfg, runner, registry)
+        run = submitter.submit_remote(
+            "imagenet", {"data_format": "synthetic"}, max_retries=1
+        )
+        assert run.status == "completed"
+        ssh_calls = [
+            a for a in runner.history
+            if "ssh" in a and any("workloads." in x for x in a)
+        ]
+        assert len(ssh_calls) == 2
+        assert ssh_calls[0][ssh_calls[0].index("--command") + 1] == (
+            ssh_calls[1][ssh_calls[1].index("--command") + 1]
+        )  # identical resubmit (resume comes from the checkpoint dir)
+        assert any("delete" in a for a in runner.history)  # recreate path
+        # fresh VMs get re-bootstrapped (scp + pip install) before resubmit
+        assert any("scp" in a for a in runner.history)
+        assert any(
+            "pip install" in a[a.index("--command") + 1]
+            for a in runner.history
+            if "ssh" in a and "--command" in a
+        )
+
+    def test_remote_no_retry_when_pod_ready(self, submit_env):
+        """A workload failure on a healthy pod must NOT trigger recreate —
+        the same code would fail the same way."""
+        cfg, _, registry = submit_env
+        runner = self._preemption_runner(pod_state="READY", fail_ssh_times=9)
+        submitter = Submitter(cfg, runner, registry)
+        run = submitter.submit_remote(
+            "imagenet", {"data_format": "synthetic"}, max_retries=3
+        )
+        assert run.status == "failed"
+        assert len([
+            a for a in runner.history
+            if "ssh" in a and any("workloads." in x for x in a)
+        ]) == 1
+        assert not any("delete" in a for a in runner.history)
+
+    def test_remote_retry_default_from_settings(self, submit_env):
+        cfg, _, registry = submit_env
+        cfg.values["MAX_RETRIES"] = "2"
+        runner = self._preemption_runner(
+            pod_state="PREEMPTED", fail_ssh_times=2
+        )
+        submitter = Submitter(cfg, runner, registry)
+        run = submitter.submit_remote("imagenet", {"data_format": "synthetic"})
+        assert run.status == "completed"
+        assert len([
+            a for a in runner.history
+            if "ssh" in a and any("workloads." in x for x in a)
+        ]) == 3
+
     def test_remote_requires_bucket_for_datastore_paths(self, tmp_path):
         env_file = tmp_path / ".env"
         env_file.write_text("TPU_NAME=p\n")
