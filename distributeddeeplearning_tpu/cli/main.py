@@ -213,6 +213,12 @@ def build_parser() -> argparse.ArgumentParser:
     _add_submit_tree(sub, "benchmark", formats=("synthetic",))
     _add_submit_tree(sub, "experiment", formats=())
 
+    inter_p = sub.add_parser(
+        "interactive",
+        help="Open an interactive shell on a pod worker (inv interactive)",
+    )
+    inter_p.add_argument("--worker", default="0")
+
     tb_p = sub.add_parser("tensorboard", help="TensorBoard over registry runs")
     tb_p.add_argument("--experiment", default=None)
     tb_p.add_argument("--run", default=None)
@@ -344,6 +350,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_storage(args)
     if args.command in ("imagenet", "bert", "benchmark", "experiment"):
         return _submit(args, args.command, extra)
+    if args.command == "interactive":
+        from distributeddeeplearning_tpu.control.tpu import pod_from_settings
+
+        cfg, runner, _ = _control(args)
+        pod_from_settings(cfg, runner).interactive(worker=args.worker)
+        return 0
     if args.command == "tensorboard":
         return _cmd_tensorboard(args)
     if args.command == "runs":

@@ -45,6 +45,7 @@ DEFAULTS: Dict[str, str] = {
     "CONTAINER_NAME": "data",
     # Runtime knobs.
     "MAX_RETRIES": "0",  # remote-submit preemption retries
+    "PROJECT_DIR": ".",  # source tree scp'd to workers by bootstrap/retry
     "LOG_CONFIG": "",
     "EPOCHS": "90",
     "BATCH_SIZE_PER_CHIP": "64",

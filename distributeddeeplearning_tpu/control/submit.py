@@ -172,7 +172,7 @@ class Submitter:
         pod: Optional[TpuPod] = None,
         python: str = "python3",
         max_retries: Optional[int] = None,
-        project_dir: str = ".",
+        project_dir: Optional[str] = None,  # default: PROJECT_DIR setting
     ) -> Run:
         """Get-or-create the pod, fan the launcher out over all workers.
 
@@ -233,10 +233,23 @@ class Submitter:
                 "and resubmitting (%d/%d)",
                 run.run_id, attempts, state, attempts, max_retries,
             )
-            pod.recreate()
-            # Fresh VMs have nothing installed: re-run the bootstrap (scp +
-            # pip install) or the identical resubmit dies on import.
-            self.bootstrap_pod(project_dir, pod=pod)
+            try:
+                pod.recreate()
+                # Fresh VMs have nothing installed: re-run the bootstrap
+                # (scp + pip install) or the identical resubmit dies on
+                # import.  PROJECT_DIR names the source tree to ship.
+                self.bootstrap_pod(
+                    project_dir or self.settings.get("PROJECT_DIR", "."),
+                    pod=pod,
+                )
+            except Exception as exc:  # capacity stockout, transient gcloud
+                # The run must never be stranded in "running": record the
+                # failure and stop retrying.
+                logger.error(
+                    "run %s: pod recreate/bootstrap failed (%s); giving up",
+                    run.run_id, exc,
+                )
+                break
             result = pod.ssh(command, worker="all", env=env, check=False)
             attempts += 1
         if not result.ok:

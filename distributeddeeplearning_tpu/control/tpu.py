@@ -173,6 +173,16 @@ class TpuPod:
             check=check,
         )
 
+    def interactive(self, *, worker: str = "0"):
+        """Open an interactive shell on one worker (``inv interactive``
+        parity, ``README.md:271-311``): plain gcloud ssh, no --command."""
+        return self.runner.run(
+            self._base("ssh", self.name)
+            + ["--zone", self.zone, "--worker", str(worker)],
+            capture=False,
+            check=False,
+        )
+
     def scp(self, src: str, dst: str, *, worker: str = "all"):
         """Copy files to pod workers (code distribution before launch)."""
         return self.runner.run(

@@ -7,13 +7,17 @@ make long-context and sequence-parallel training first-class on TPU:
 - ring attention — blockwise attention with k/v rotating around the ``seq``
   mesh axis via ``ppermute``, overlapping compute with ICI transfers;
 - flash attention — the single-device Pallas kernel: the same online-softmax
-  recurrence blocked over VMEM, O(block²) memory, custom VJP.
+  recurrence blocked over VMEM, O(block²) memory, custom VJP;
+- pipeline — GPipe-style stage parallelism over the ``pipe`` axis:
+  microbatch activations rotate between stage-holding ranks via
+  ``ppermute``, differentiable end to end.
 """
 
 from distributeddeeplearning_tpu.ops.flash_attention import (
     flash_attention,
     make_flash_attention,
 )
+from distributeddeeplearning_tpu.ops.pipeline import pipeline_apply
 from distributeddeeplearning_tpu.ops.ring_attention import (
     make_ring_attention,
     ring_attention,
@@ -23,5 +27,6 @@ __all__ = [
     "flash_attention",
     "make_flash_attention",
     "make_ring_attention",
+    "pipeline_apply",
     "ring_attention",
 ]

@@ -238,16 +238,7 @@ def make_flash_attention(block_q: int = 512, block_k: int = 512, mesh=None):
 
         from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
 
-        try:
-            from jax import shard_map as _shard_map
-
-            def shard_map(f, **kw):
-                # check_rep was renamed check_vma in jax>=0.8; the pallas
-                # call inside cannot annotate vma, so disable the check.
-                kw.pop("check_rep", None)
-                return _shard_map(f, check_vma=False, **kw)
-        except ImportError:  # pragma: no cover - older jax
-            from jax.experimental.shard_map import shard_map
+        from distributeddeeplearning_tpu.parallel.compat import shard_map
 
         if mask is None:
             mask = jnp.ones((q.shape[0], 1, 1, q.shape[1]), bool)
@@ -262,7 +253,6 @@ def make_flash_attention(block_q: int = 512, block_k: int = 512, mesh=None):
             mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
             out_specs=qkv_spec,
-            check_rep=False,
         )(q, k, v, mask)
 
     return attention_fn

@@ -1,0 +1,147 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis — GPipe on ppermute.
+
+The last of the framework's mesh axes (``parallel/mesh.py`` AXIS_ORDER) gets
+its consumer: a stage-parallel executor for layer-stacked models.  The
+reference has nothing comparable (Horovod DP only); this is the
+beyond-reference axis that lets depth scale past one chip's HBM.
+
+TPU-native shape (the scaling-book recipe, no send/recv runtime):
+
+- the model is S identical stages whose params are STACKED on a leading
+  stage dim ``[S, ...]`` and sharded over ``pipe`` — each pipe rank holds
+  exactly one stage's weights;
+- the global batch splits into M microbatches; inside ``shard_map`` a
+  ``lax.scan`` runs the classic GPipe schedule of ``M + S - 1`` ticks:
+  every tick each rank applies its stage, then activations rotate one hop
+  along the pipe ring via ``jax.lax.ppermute`` (XLA compiles this onto ICI;
+  the transfer overlaps the next tick's compute);
+- rank 0 injects microbatch t on tick t; the last rank's outputs are
+  collected on ticks S-1 … S+M-2 and replicated back over the pipe axis
+  with a masked ``psum`` so the caller sees an ordinary batch-sharded
+  result;
+- fully differentiable (scan + ppermute + psum all have transposes), so
+  ``jax.grad`` through ``pipeline_apply`` trains the stacked stages.
+
+Bubble fraction is the usual (S-1)/(M+S-1) — pick M >> S.
+
+Composes with the other axes: batch stays sharded over (data, fsdp) inside
+each microbatch; ``pipe`` only moves activations between stages.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
+
+PyTree = Any
+
+
+from distributeddeeplearning_tpu.parallel.compat import shard_map
+
+
+def pipeline_apply(
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    stage_params: PyTree,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "pipe",
+) -> jax.Array:
+    """Run ``stage_fn`` S times as a pipeline: ``y = fS(...f2(f1(x)))``.
+
+    ``stage_params`` leaves are stacked ``[S, ...]`` with S == the mesh's
+    ``pipe`` size; ``x`` is the global batch ``[B, ...]`` (sharded over the
+    data axes as usual), ``B`` divisible by ``num_microbatches`` and the
+    microbatch size divisible by the data axes.  ``stage_fn(params, mb)``
+    must preserve the microbatch shape (the pipeline carries one activation
+    buffer per rank).
+    """
+    n_stages = int(mesh.shape[axis_name])
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    if not leaves:
+        raise ValueError("stage_params is empty")
+    for leaf in leaves:
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leading dim {leaf.shape[0]} != pipe axis size "
+                f"{n_stages}"
+            )
+    batch = x.shape[0]
+    data_shards = 1
+    for axis in DATA_AXES:
+        data_shards *= int(mesh.shape[axis])
+    if batch % data_shards:
+        raise ValueError(
+            f"batch {batch} not divisible by the data-axes product {data_shards}"
+        )
+    local_batch = batch // data_shards
+    if local_batch % num_microbatches:
+        # The microbatch split happens on each data shard's local slice.
+        raise ValueError(
+            f"per-data-shard batch {local_batch} (= {batch} / {data_shards} "
+            f"data shards) not divisible by num_microbatches {num_microbatches}"
+        )
+
+    m = num_microbatches
+    param_spec = jax.tree_util.tree_map(
+        lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), stage_params
+    )
+    x_spec = P(DATA_AXES, *([None] * (x.ndim - 1)))
+
+    def shard_fn(params_local, x_local):
+        # params_local: [1, ...] (this rank's stage); x_local: [B_local, ...]
+        params_here = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        mb = x_local.shape[0] // m
+        x_mbs = x_local.reshape(m, mb, *x_local.shape[1:])
+        rank = jax.lax.axis_index(axis_name)
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def tick(carry, t):
+            state, out = carry
+            # rank 0 injects microbatch t (zeros once the batch is drained);
+            # other ranks consume the activation permuted in last tick.
+            inject = jnp.where(
+                t < m,
+                x_mbs[jnp.minimum(t, m - 1)],
+                jnp.zeros_like(state),
+            )
+            stage_in = jnp.where(rank == 0, inject, state)
+            y = stage_fn(params_here, stage_in)
+            # collect on the last rank while its outputs are valid
+            slot = t - (n_stages - 1)
+            valid = (rank == n_stages - 1) & (slot >= 0) & (slot < m)
+            out = jax.lax.cond(
+                valid,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, y, jnp.maximum(slot, 0), axis=0
+                ),
+                lambda o: o,
+                out,
+            )
+            state = jax.lax.ppermute(y, axis_name, perm)
+            return (state, out), None
+
+        state0 = jnp.zeros(x_mbs.shape[1:], x.dtype)
+        out0 = jnp.zeros_like(x_mbs)
+        (_, out), _ = jax.lax.scan(
+            tick, (state0, out0), jnp.arange(m + n_stages - 1)
+        )
+        # only the last rank holds real outputs: masked psum replicates them
+        out = jax.lax.psum(
+            jnp.where(rank == n_stages - 1, out, jnp.zeros_like(out)),
+            axis_name,
+        )
+        return out.reshape(x_local.shape)
+
+    return shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(param_spec, x_spec),
+        out_specs=x_spec,
+    )(stage_params, x)

@@ -92,14 +92,7 @@ def ring_attention(
     inputs are global ``[B, S, H, D]`` arrays (sharded batch over the data
     axes, sequence over ``seq``); output has the same layout.
     """
-    try:
-        from jax import shard_map as _shard_map
-
-        def shard_map(f, **kw):
-            kw.pop("check_rep", None)  # renamed in jax>=0.8 (check_vma)
-            return _shard_map(f, **kw)
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from distributeddeeplearning_tpu.parallel.compat import shard_map
 
     if mesh.shape[axis_name] == 1:
         # No ring to rotate — plain fused attention (XLA handles it).
@@ -123,7 +116,6 @@ def ring_attention(
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec,
-        check_rep=False,
     )(q, k, v, mask)
 
 

@@ -125,6 +125,14 @@ class TestTpuPod:
         assert command.startswith("export A=1 DISTRIBUTED=True && ")
         assert command.endswith("python3 -m foo")
 
+    def test_interactive_composes_plain_ssh(self):
+        runner = FakeRunner()
+        pod = make_pod(runner)
+        pod.interactive(worker="2")
+        argv = runner.history[-1]
+        assert "ssh" in argv and argv[argv.index("--worker") + 1] == "2"
+        assert "--command" not in argv  # interactive shell, not a command
+
     def test_preemptible_flag(self):
         runner = FakeRunner(
             [(_describe_missing, CommandResult([], returncode=1))]
@@ -317,6 +325,64 @@ class TestSubmitter:
             if "ssh" in a and any("workloads." in x for x in a)
         ]) == 1
         assert not any("delete" in a for a in runner.history)
+
+    def test_recreate_failure_records_failed_not_running(self, submit_env):
+        """Capacity stockout during recreate must not strand the run in
+        'running' — it records 'failed' and stops."""
+        cfg, _, registry = submit_env
+        seen = {"deleted": False}
+
+        def workload_ssh(argv):
+            return "ssh" in argv and any("workloads." in a for a in argv)
+
+        def delete_marks(argv):
+            if "delete" in argv:
+                seen["deleted"] = True
+            return False  # observe only; default rc=0 applies
+
+        def describe(argv):
+            return "describe" in argv
+
+        def create_after_delete(argv):
+            # the recreate attempt hits a capacity stockout
+            return "create" in argv and seen["deleted"]
+
+        runner = FakeRunner(
+            [
+                (delete_marks, CommandResult([], returncode=0)),
+                (workload_ssh, CommandResult([], returncode=255)),
+                (create_after_delete, CommandResult([], returncode=1)),
+                (
+                    describe,
+                    # exists (PREEMPTED) until deleted, then missing
+                    CommandResult([], returncode=0,
+                                  stdout='{"state": "PREEMPTED"}'),
+                ),
+            ]
+        )
+
+        # swap the describe response to missing once the pod was deleted
+        orig_run = runner.run
+
+        def run_with_state(argv, **kw):
+            argv_s = [str(a) for a in argv]
+            if "describe" in argv_s and seen["deleted"]:
+                runner.history.append(argv_s)
+                runner.envs.append(kw.get("env"))
+                return CommandResult(argv=argv_s, returncode=1)
+            return orig_run(argv, **kw)
+
+        runner.run = run_with_state
+        submitter = Submitter(cfg, runner, registry)
+        run = submitter.submit_remote(
+            "imagenet", {"data_format": "synthetic"}, max_retries=2
+        )
+        assert run.status == "failed"
+        # the stockout aborted the retry: only one workload launch happened
+        assert (
+            len([a for a in runner.history if "ssh" in a
+                 and any("workloads." in x for x in a)]) == 1
+        )
 
     def test_remote_retry_default_from_settings(self, submit_env):
         cfg, _, registry = submit_env
