@@ -282,6 +282,10 @@ class Submitter:
         """
         pod = pod or pod_from_settings(self.settings, self.runner)
         pod.create()
+        # Remember the tree that was shipped: preemption retries re-bootstrap
+        # from PROJECT_DIR, which must match what the operator bootstrapped
+        # with (not whatever cwd a later submit happens to run from).
+        self.settings.persist("PROJECT_DIR", str(Path(project_dir).absolute()))
         pod.scp(str(Path(project_dir)), remote_dir, worker="all")
         install = f"pip install -q -e {remote_dir}"
         if (Path(project_dir) / "envs" / "requirements-tpu.txt").exists():

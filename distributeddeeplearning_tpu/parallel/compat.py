@@ -18,14 +18,14 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
     """
     try:
         from jax import shard_map as _shard_map
-
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map as _shard_map
+    try:
         return _shard_map(
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=check_vma,
         )
-    except ImportError:  # pragma: no cover - older jax
-        from jax.experimental.shard_map import shard_map as _shard_map
-
+    except TypeError:  # pragma: no cover - jax<0.8 spells it check_rep
         return _shard_map(
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             check_rep=check_vma,
