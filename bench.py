@@ -38,12 +38,78 @@ import sys
 V100_TF_CNN_BENCHMARKS_IMG_SEC = 720.0
 
 
+def _build_bert_bench(args, devices=None):
+    """BERT fine-tune step benchmark (BASELINE.md's tracked transformer
+    config): AdamW, bf16, full-length synthetic token batch, --seq-len."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.parallel import (
+        MeshSpec,
+        create_mesh,
+        shard_batch,
+    )
+    from distributeddeeplearning_tpu.parallel.sharding import model_logical_axes
+    from distributeddeeplearning_tpu.train.schedule import (
+        warmup_linear_decay_schedule,
+    )
+    from distributeddeeplearning_tpu.train.state import adamw, create_train_state
+    from distributeddeeplearning_tpu.train.step import build_train_step
+
+    mesh = create_mesh(MeshSpec(), devices=devices)
+    n_dev = mesh.devices.size
+    global_batch = args.batch_size * n_dev
+    dtype = jnp.float32 if args.fp32 else jnp.bfloat16
+
+    model_kwargs = dict(num_classes=2, dropout_rate=0.0, dtype=dtype)
+    if args.small:
+        # tiny config for CI smoke — full bert-base takes minutes on CPU
+        model_kwargs.update(
+            num_layers=2, hidden_size=64, num_heads=4, intermediate_size=128,
+            vocab_size=1031, max_position_embeddings=args.seq_len,
+        )
+    model = get_model(args.model, **model_kwargs)
+    sched = warmup_linear_decay_schedule(3e-5, 10_000)
+    tx = adamw(sched)
+    axes = model_logical_axes(
+        model, jax.random.key(0),
+        np.zeros((global_batch, args.seq_len), np.int32), train=False,
+    )
+    state = create_train_state(
+        jax.random.key(0), model, (global_batch, args.seq_len), tx,
+        input_dtype=jnp.int32,
+    )
+    step = build_train_step(
+        mesh, state, schedule=sched, compute_dtype=dtype, logical_axes=axes
+    )
+    rng = np.random.default_rng(0)
+    batch = shard_batch(
+        mesh,
+        {
+            "input": rng.integers(
+                0, 1031 if args.small else 30522, (global_batch, args.seq_len)
+            ).astype(np.int32),
+            "attention_mask": np.ones(
+                (global_batch, args.seq_len), np.int32
+            ),
+            "label": rng.integers(0, 2, (global_batch,)).astype(np.int32),
+        },
+    )
+    init_shape = (global_batch, args.seq_len)
+    init_kw = {"input_dtype": jnp.int32}
+    return step, state, batch, n_dev, (mesh, model, tx, init_shape, init_kw)
+
+
 def _build_bench(args, devices=None):
     """(step, state, batch, n_dev, parts) for one mesh over ``devices``.
 
     ``parts`` carries (mesh, model, tx) so callers can mint additional
     TrainStates whose static metadata (apply_fn, tx) matches the jitted
     step — a state built from a NEW model/tx instance would not."""
+    if args.model.startswith("bert"):
+        return _build_bert_bench(args, devices)
     import jax
     import jax.numpy as jnp
 
@@ -75,7 +141,8 @@ def _build_bench(args, devices=None):
     )
     step = build_train_step(mesh, state, schedule=sched, compute_dtype=dtype)
     batch = shard_batch(mesh, synthetic_batch(global_batch, img_shape))
-    return step, state, batch, n_dev, (mesh, model, tx)
+    init_shape = (args.batch_size, *img_shape)
+    return step, state, batch, n_dev, (mesh, model, tx, init_shape, {})
 
 
 def _run_single(args) -> int:
@@ -87,7 +154,9 @@ def _run_single(args) -> int:
         step_flops,
     )
 
-    step, state, batch, n_dev, (mesh, model, tx) = _build_bench(args)
+    step, state, batch, n_dev, (mesh, model, tx, init_shape, init_kw) = (
+        _build_bench(args)
+    )
     global_batch = args.batch_size * n_dev
 
     # Compile once up front (lowering does not consume the donated state) and
@@ -144,8 +213,7 @@ def _run_single(args) -> int:
         # Fresh state with the SAME model/tx objects (identical pytree
         # metadata) driven through the SAME jitted step — no recompile.
         state2 = create_train_state(
-            _jax.random.key(1), model,
-            (args.batch_size, args.image_size, args.image_size, 3), tx,
+            _jax.random.key(1), model, init_shape, tx, **init_kw
         )
         batch2 = batch
         steps = max(args.num_iters * args.num_batches_per_iter, 20)
@@ -163,8 +231,7 @@ def _run_single(args) -> int:
         # metric accumulator) with a short fit so the timed epoch measures
         # steady state, not first-call compiles.
         warm_state = create_train_state(
-            _jax.random.key(2), model,
-            (args.batch_size, args.image_size, args.image_size, 3), tx,
+            _jax.random.key(2), model, init_shape, tx, **init_kw
         )
         warm = Trainer(
             mesh,
@@ -178,11 +245,19 @@ def _run_single(args) -> int:
         _, fit_result = trainer.fit(state2, itertools.repeat(batch2))
         fit_img_sec = fit_result.images_per_second / n_dev
 
+    is_bert = args.model.startswith("bert")
     line = {
-        "metric": f"{args.model}_synthetic_train_img_sec_per_chip",
+        "metric": (
+            f"{args.model}_synthetic_finetune_ex_sec_per_chip"
+            if is_bert
+            else f"{args.model}_synthetic_train_img_sec_per_chip"
+        ),
         "value": round(result.img_sec_per_chip_mean, 1),
-        "unit": "img/sec/chip",
-        "vs_baseline": round(
+        "unit": "ex/sec/chip" if is_bert else "img/sec/chip",
+        # The V100 yardstick is a ResNet-50 image-throughput figure; for the
+        # BERT mode there is no comparable published baseline, so the field
+        # is null rather than a bogus cross-model ratio.
+        "vs_baseline": None if is_bert else round(
             result.img_sec_per_chip_mean / V100_TF_CNN_BENCHMARKS_IMG_SEC, 3
         ),
     }
@@ -191,7 +266,7 @@ def _run_single(args) -> int:
     if flops is not None:
         line["step_gflops"] = round(flops / 1e9, 1)
     if fit_img_sec is not None:
-        line["fit_img_sec_per_chip"] = round(fit_img_sec, 1)
+        line["fit_throughput_per_chip"] = round(fit_img_sec, 1)
         line["fit_vs_harness"] = round(
             fit_img_sec / result.img_sec_per_chip_mean, 3
         )
@@ -229,7 +304,7 @@ def _run_scaling(args) -> int:
             if args.trace_dir
             else contextlib.nullcontext()
         )
-        step, state, batch, n_dev, _parts = _build_bench(
+        step, state, batch, n_dev, _ = _build_bench(
             args, devices=jax.devices()[:n]
         )
         with trace:
@@ -271,6 +346,8 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch-size", type=int, default=256)
     parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--seq-len", type=int, default=128,
+                        help="sequence length for --model bert-*")
     parser.add_argument("--model", default="resnet50")
     parser.add_argument("--num-iters", type=int, default=5)
     parser.add_argument("--num-batches-per-iter", type=int, default=20)
@@ -303,6 +380,8 @@ def main() -> int:
     if args.small:
         args.batch_size, args.image_size = 16, 64
         args.num_iters, args.num_batches_per_iter, args.num_warmup = 2, 2, 1
+        if args.model.startswith("bert"):
+            args.batch_size, args.seq_len = 4, 32
 
     if args.devices:
         return _run_scaling(args)
