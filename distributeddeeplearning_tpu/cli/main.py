@@ -360,7 +360,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_tensorboard(args)
     if args.command == "runs":
         cfg, _, registry = _control(args)
-        experiment = args.experiment or cfg.get("EXPERIMENT_NAME")
+        experiment = args.experiment or cfg.get("EXPERIMENT_NAME") or "experiment"
         print(registry.format_runs(experiment, args.last))
         return 0
     if args.command == "experiments":
@@ -571,13 +571,20 @@ def _cmd_storage(args) -> int:
 
 
 def _cmd_tensorboard(args) -> int:
-    """Point TensorBoard at registry run logdirs (``inv tensorboard`` role;
-    streaming-from-cloud becomes: checkpoints/TB events live in the run dir
-    or the bucket — pass the run's tb dir straight to tensorboard)."""
+    """Point TensorBoard at run logdirs (``inv tensorboard`` role).
+
+    ``--run`` resolves the dir recorded at submit time — a ``gs://`` dir
+    for remote runs, so a RUNNING pod job's scalars stream live (the
+    reference's azureml.tensorboard role); local runs resolve to the
+    registry tree."""
     cfg, runner, registry = _control(args)
-    experiment = args.experiment or cfg.get("EXPERIMENT_NAME")
+    # same default the submit paths register runs under
+    experiment = args.experiment or cfg.get("EXPERIMENT_NAME") or "experiment"
     if args.run:
-        logdir = str(registry.root / experiment / args.run / "tb")
+        record = registry.find(experiment, args.run)
+        logdir = (record.extra.get("tensorboard_dir") if record else None) or (
+            str(registry.root / experiment / args.run / "tb")
+        )
     else:
         logdir = str(registry.root / experiment)
     runner.run(

@@ -100,6 +100,20 @@ class RunRegistry:
     def checkpoint_dir(self, run: Run) -> Path:
         return self.run_dir(run) / "ckpt"
 
+    @staticmethod
+    def _load(meta: Path) -> Optional[Run]:
+        if not meta.exists():
+            return None
+        try:
+            payload = json.loads(meta.read_text())
+        except json.JSONDecodeError:
+            return None
+        known = {f.name for f in dataclasses.fields(Run)}
+        return Run(**{k: v for k, v in payload.items() if k in known})
+
+    def find(self, experiment: str, run_id: str) -> Optional[Run]:
+        return self._load(self._run_dir(experiment, run_id) / RUN_FILE)
+
     # -- listing verbs (``inv runs`` / ``inv experiments`` parity) -------
 
     def experiments(self) -> List[str]:
@@ -113,15 +127,10 @@ class RunRegistry:
             return []
         loaded: List[Run] = []
         for run_dir in sorted(exp_dir.iterdir(), reverse=True):
-            meta = run_dir / RUN_FILE
-            if not meta.exists():
+            run = self._load(run_dir / RUN_FILE)
+            if run is None:
                 continue
-            try:
-                payload = json.loads(meta.read_text())
-            except json.JSONDecodeError:
-                continue
-            known = {f.name for f in dataclasses.fields(Run)}
-            loaded.append(Run(**{k: v for k, v in payload.items() if k in known}))
+            loaded.append(run)
             if len(loaded) >= last:
                 break
         return loaded

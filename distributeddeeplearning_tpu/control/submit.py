@@ -147,6 +147,7 @@ class Submitter:
         params.setdefault("save_filepath", str(self.registry.checkpoint_dir(run)))
         argv = self._launch_argv(workload, params, python=sys.executable)
         run.argv = argv
+        run.extra["tensorboard_dir"] = str(params["tensorboard_dir"])
         env = dict(os.environ)
         env["DISTRIBUTED"] = str(distributed)
         log_config = self.settings.get("LOG_CONFIG")
@@ -205,6 +206,11 @@ class Submitter:
             params.setdefault("save_filepath", f"{remote_root}/ckpt")
         argv = self._launch_argv(workload, params, python=python)
         run.argv = argv
+        if "tensorboard_dir" in params:
+            # ``ddlt tensorboard --run ID`` resolves this — a gs:// dir
+            # streams a RUNNING remote job's scalars (the reference's
+            # azureml.tensorboard streaming role, aml_compute.py:567-635).
+            run.extra["tensorboard_dir"] = str(params["tensorboard_dir"])
 
         env = {"DISTRIBUTED": "True"}
         log_config = self.settings.get("LOG_CONFIG")

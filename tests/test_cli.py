@@ -95,6 +95,28 @@ def test_dry_run_setup_skips_data_plane(project, capsys):
     assert "setup complete (dry run)" in out
 
 
+def test_tensorboard_resolves_remote_run_gs_dir(project, capsys):
+    """A remote run's recorded gs:// TB dir wins over the local registry
+    path — streaming a running pod job (aml_compute.py:567-635 role)."""
+    from distributeddeeplearning_tpu.control.runs import RunRegistry
+
+    registry = RunRegistry("runs")
+    run = registry.new_run("e2e", "imagenet", "remote", [])
+    run.extra["tensorboard_dir"] = f"gs://bkt/runs/e2e/{run.run_id}/tb"
+    registry.update(run, status="running")
+
+    rc = main(["--dry-run", "tensorboard", "--run", run.run_id])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert f"gs://bkt/runs/e2e/{run.run_id}/tb" in out
+
+    # a run without a recorded dir falls back to the local registry tree
+    run2 = registry.new_run("e2e", "imagenet", "local", [])
+    rc = main(["--dry-run", "tensorboard", "--run", run2.run_id])
+    out = capsys.readouterr().out
+    assert rc == 0 and f"runs/e2e/{run2.run_id}/tb" in out
+
+
 def test_dry_run_storage_and_tpu_verbs(project, capsys):
     assert main(["--dry-run", "storage", "create-bucket"]) == 0
     assert "gcloud storage buckets create gs://bkt" in capsys.readouterr().out
