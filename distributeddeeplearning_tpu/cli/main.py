@@ -227,6 +227,10 @@ def build_parser() -> argparse.ArgumentParser:
     runs_p = sub.add_parser("runs", help="List last N runs of an experiment")
     runs_p.add_argument("--experiment", default=None)
     runs_p.add_argument("--last", type=int, default=10)
+    runs_p.add_argument(
+        "--run", default=None,
+        help="Show one run's per-epoch metric rows (run.log_row role)",
+    )
 
     sub.add_parser("experiments", help="List experiments in the run registry")
 
@@ -361,6 +365,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.command == "runs":
         cfg, _, registry = _control(args)
         experiment = args.experiment or cfg.get("EXPERIMENT_NAME") or "experiment"
+        if args.run:
+            record = registry.find(experiment, args.run)
+            path = (record.extra.get("metrics_path") if record else None) or str(
+                registry.run_dir_for(experiment, args.run) / "metrics.jsonl"
+            )
+            content = _read_text_maybe_gs(path)
+            if content is None:
+                print(f"no metrics recorded for {experiment}/{args.run}")
+                return 1
+            print(content.rstrip())
+            return 0
         print(registry.format_runs(experiment, args.last))
         return 0
     if args.command == "experiments":
@@ -385,6 +400,21 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     parser.print_help()
     return 2
+
+
+def _read_text_maybe_gs(path: str):
+    """File contents, following gs:// via tf.io.gfile; None when absent."""
+    if path.startswith("gs://"):
+        import tensorflow as tf
+
+        if not tf.io.gfile.exists(path):
+            return None
+        with tf.io.gfile.GFile(path, "r") as f:
+            return f.read()
+    from pathlib import Path as _Path
+
+    p = _Path(path)
+    return p.read_text() if p.exists() else None
 
 
 def _cmd_setup(args) -> int:

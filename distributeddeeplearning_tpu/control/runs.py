@@ -94,6 +94,9 @@ class RunRegistry:
     def run_dir(self, run: Run) -> Path:
         return self._run_dir(run.experiment, run.run_id)
 
+    def run_dir_for(self, experiment: str, run_id: str) -> Path:
+        return self._run_dir(experiment, run_id)
+
     def tensorboard_dir(self, run: Run) -> Path:
         return self.run_dir(run) / "tb"
 

@@ -145,9 +145,13 @@ class Submitter:
         run = self.registry.new_run(experiment, workload, "local", [])
         params.setdefault("tensorboard_dir", str(self.registry.tensorboard_dir(run)))
         params.setdefault("save_filepath", str(self.registry.checkpoint_dir(run)))
+        params.setdefault(
+            "metrics_path", str(self.registry.run_dir(run) / "metrics.jsonl")
+        )
         argv = self._launch_argv(workload, params, python=sys.executable)
         run.argv = argv
         run.extra["tensorboard_dir"] = str(params["tensorboard_dir"])
+        run.extra["metrics_path"] = str(params["metrics_path"])
         env = dict(os.environ)
         env["DISTRIBUTED"] = str(distributed)
         log_config = self.settings.get("LOG_CONFIG")
@@ -204,6 +208,7 @@ class Submitter:
             remote_root = f"gs://{bucket}/runs/{experiment}/{run.run_id}"
             params.setdefault("tensorboard_dir", f"{remote_root}/tb")
             params.setdefault("save_filepath", f"{remote_root}/ckpt")
+            params.setdefault("metrics_path", f"{remote_root}/metrics.jsonl")
         argv = self._launch_argv(workload, params, python=python)
         run.argv = argv
         if "tensorboard_dir" in params:
@@ -211,6 +216,8 @@ class Submitter:
             # streams a RUNNING remote job's scalars (the reference's
             # azureml.tensorboard streaming role, aml_compute.py:567-635).
             run.extra["tensorboard_dir"] = str(params["tensorboard_dir"])
+        if "metrics_path" in params:
+            run.extra["metrics_path"] = str(params["metrics_path"])
 
         env = {"DISTRIBUTED": "True"}
         log_config = self.settings.get("LOG_CONFIG")

@@ -49,6 +49,54 @@ _acc_add = jax.jit(lambda a, b: jax.tree.map(jnp_add, a, b))
 Batch = Dict[str, np.ndarray]
 
 
+class MetricsLog:
+    """Append-only JSONL of per-epoch metric rows (AML ``run.log_row`` role).
+
+    Rank-0 only; best-effort — a failing log write must never kill training.
+    GCS objects are immutable, so the gs:// path keeps the accumulated rows
+    in memory (seeded once from an existing file on resume) and rewrites the
+    small object per append — one upload, no per-epoch re-read.
+    """
+
+    def __init__(self, path: Optional[str]):
+        self.path = path if (path and is_primary()) else None
+        self._buffer = ""
+        if self.path is None:
+            return
+        if self.path.startswith("gs://"):
+            try:
+                import tensorflow as tf
+
+                if tf.io.gfile.exists(self.path):  # resume: keep prior rows
+                    with tf.io.gfile.GFile(self.path, "r") as f:
+                        self._buffer = f.read()
+            except Exception as exc:  # pragma: no cover
+                logger.warning("metrics log init failed (%s): %s", self.path, exc)
+        else:
+            import os
+
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+
+    def append(self, row: Dict[str, Any]) -> None:
+        if self.path is None:
+            return
+        import json
+
+        line = json.dumps(row) + "\n"
+        try:
+            if self.path.startswith("gs://"):
+                import tensorflow as tf
+
+                self._buffer += line
+                with tf.io.gfile.GFile(self.path, "w") as f:
+                    f.write(self._buffer)
+            else:
+                with open(self.path, "a") as f:
+                    f.write(line)
+        except Exception as exc:  # pragma: no cover - environment-specific
+            logger.warning("metrics log write failed (%s): %s", self.path, exc)
+
+
 class TensorBoardLogger:
     """Rank-0 TensorBoard scalar writer (tensorboardX parity,
     ``imagenet_pytorch_horovod.py:325-329,426-436``), via tf.summary."""
@@ -90,6 +138,10 @@ class TrainerConfig:
     profile_dir: Optional[str] = None
     profile_start: int = 10  # skip compile + warmup steps
     profile_steps: int = 10
+    # Per-epoch metric rows appended as JSONL (primary process only) — the
+    # reference's AML run.log_row channel (imagenet_pytorch_horovod.py:424-435).
+    # Local paths and gs:// both work (gs via tf.io.gfile when available).
+    metrics_path: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -121,6 +173,7 @@ class Trainer:
         self.eval_step = eval_step
         self.config = config
         self.tb = TensorBoardLogger(config.tensorboard_dir)
+        self.metrics_log = MetricsLog(config.metrics_path)
         self.checkpointer = (
             Checkpointer(config.checkpoint_dir, max_to_keep=config.max_to_keep)
             if config.checkpoint_dir
@@ -175,6 +228,7 @@ class Trainer:
             # A per-step float() sync would serialize dispatch and was the
             # gap between Trainer.fit and the benchmark harness throughput.
             acc = None
+            epoch_t0 = time.monotonic()
             for step_i in range(cfg.steps_per_epoch):
                 if profile_pending and global_step >= profile_start:
                     jax.profiler.start_trace(cfg.profile_dir)
@@ -204,6 +258,10 @@ class Trainer:
             train_metrics = {
                 k: float(v) / cfg.steps_per_epoch for k, v in acc.items()
             }
+            # train-phase wall of THIS epoch (the float() above synced):
+            # excludes the eval/checkpoint below, so per-epoch throughput
+            # rows are comparable across epochs.
+            epoch_train_wall = time.monotonic() - epoch_t0
             if is_primary():
                 logger.info(
                     "epoch %d/%d: %s",
@@ -222,6 +280,16 @@ class Trainer:
                         {k: round(v, 4) for k, v in eval_metrics.items()},
                     )
                 self.tb.scalars("val", eval_metrics, epoch)
+
+            # run.log_row parity: one row per epoch with both metric sets
+            row: Dict[str, Any] = {"epoch": epoch + 1}
+            row.update({f"train_{k}": v for k, v in train_metrics.items()})
+            if eval_metrics:
+                row.update({f"val_{k}": v for k, v in eval_metrics.items()})
+            row["images_per_second"] = (
+                cfg.steps_per_epoch * cfg.global_batch_size
+            ) / max(epoch_train_wall, 1e-9)
+            self.metrics_log.append(row)
 
             if self.checkpointer is not None:
                 self.checkpointer.save((epoch + 1) * cfg.steps_per_epoch, state)

@@ -34,6 +34,7 @@ def main(
     base_lr: float = 0.0125,
     tensorboard_dir: Optional[str] = None,  # accepted for submit parity
     save_filepath: Optional[str] = None,  # accepted for submit parity
+    metrics_path: Optional[str] = None,  # one summary row is appended
     distributed: Optional[bool] = None,
 ):
     """Run the synthetic benchmark; returns BenchmarkResult."""
@@ -75,7 +76,7 @@ def main(
     batch = shard_batch(mesh, synthetic_batch(global_batch, img_shape, num_classes))
 
     log = logger.info if ctx.is_primary else (lambda *_: None)
-    return run_benchmark(
+    result = run_benchmark(
         step,
         state,
         batch,
@@ -87,6 +88,18 @@ def main(
         num_batches_per_iter=num_batches_per_iter,
         log=log,
     )
+    if metrics_path:
+        from distributeddeeplearning_tpu.train.loop import MetricsLog
+
+        MetricsLog(metrics_path).append(
+            {
+                "model": model,
+                "img_sec_per_chip": result.img_sec_per_chip_mean,
+                "img_sec_total": result.img_sec_total,
+                "num_devices": n_dev,
+            }
+        )
+    return result
 
 
 if __name__ == "__main__":

@@ -94,6 +94,7 @@ def main(
     tensorboard_dir: Optional[str] = None,
     resume: bool = True,
     profile_dir: Optional[str] = None,  # jax.profiler trace of steps 10-20
+    metrics_path: Optional[str] = None,  # per-epoch JSONL rows (run.log_row)
     seed: int = 42,
     compute_dtype: str = "bfloat16",
     distributed: Optional[bool] = None,
@@ -262,6 +263,7 @@ def main(
             tensorboard_dir=tensorboard_dir,
             resume=resume,
             profile_dir=profile_dir,
+            metrics_path=metrics_path,
         ),
     )
     return trainer.fit(state, train_iter, eval_factory)

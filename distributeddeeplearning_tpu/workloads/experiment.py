@@ -56,6 +56,7 @@ def main(
     compute_dtype: str = "bfloat16",
     save_filepath: Optional[str] = None,
     tensorboard_dir: Optional[str] = None,
+    metrics_path: Optional[str] = None,
     resume: bool = True,
     distributed: Optional[bool] = None,
 ):
@@ -118,6 +119,7 @@ def main(
             global_batch_size=global_batch,
             checkpoint_dir=save_filepath,
             tensorboard_dir=tensorboard_dir,
+            metrics_path=metrics_path,
             resume=resume,
         ),
     )

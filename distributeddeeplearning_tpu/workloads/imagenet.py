@@ -140,6 +140,7 @@ def main(
     augment: str = "reference",  # "inception" = stronger train-time aug
     input_pipeline: str = "tf",  # "native" = the framework's C reader + PIL
     profile_dir: Optional[str] = None,  # jax.profiler trace of steps 10-20
+    metrics_path: Optional[str] = None,  # per-epoch JSONL rows (run.log_row)
     aux_logits: bool = False,  # InceptionV3 aux head, loss weighted 0.4
 ):
     """Train; returns (state, FitResult)."""
@@ -230,6 +231,7 @@ def main(
             tensorboard_dir=tensorboard_dir,
             resume=resume,
             profile_dir=profile_dir,
+            metrics_path=metrics_path,
         ),
     )
     return trainer.fit(state, train_iter, eval_factory)

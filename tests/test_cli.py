@@ -117,6 +117,29 @@ def test_tensorboard_resolves_remote_run_gs_dir(project, capsys):
     assert rc == 0 and f"runs/e2e/{run2.run_id}/tb" in out
 
 
+def test_runs_show_metrics_rows(project, capsys):
+    """`ddlt runs --run ID` prints the per-epoch JSONL rows the Trainer
+    appended (the reference's run.log_row channel)."""
+    import json
+
+    from distributeddeeplearning_tpu.control.runs import RunRegistry
+
+    registry = RunRegistry("runs")
+    run = registry.new_run("e2e", "imagenet", "local", [])
+    metrics = registry.run_dir(run) / "metrics.jsonl"
+    metrics.write_text(
+        json.dumps({"epoch": 1, "train_loss": 2.5}) + "\n"
+        + json.dumps({"epoch": 2, "train_loss": 1.9}) + "\n"
+    )
+    rc = main(["runs", "--run", run.run_id])
+    out = capsys.readouterr().out
+    assert rc == 0 and '"epoch": 2' in out and '"train_loss": 1.9' in out
+
+    rc = main(["runs", "--run", "nope"])
+    assert rc == 1
+    assert "no metrics recorded" in capsys.readouterr().out
+
+
 def test_dry_run_storage_and_tpu_verbs(project, capsys):
     assert main(["--dry-run", "storage", "create-bucket"]) == 0
     assert "gcloud storage buckets create gs://bkt" in capsys.readouterr().out
