@@ -90,6 +90,27 @@ def test_fit_resumes_from_checkpoint(parts, tmp_path):
     assert int(state.step) == 6
 
 
+def test_metrics_jsonl_rows(parts, tmp_path):
+    """run.log_row parity: one JSON row per epoch with train/val metrics
+    and the epoch's train-phase throughput."""
+    import json
+
+    mesh, mk_state, train_step, eval_step = parts
+    path = tmp_path / "m" / "metrics.jsonl"
+    cfg = TrainerConfig(
+        epochs=2, steps_per_epoch=2, global_batch_size=GLOBAL_BATCH,
+        metrics_path=str(path),
+    )
+    Trainer(mesh, train_step, eval_step=eval_step, config=cfg).fit(
+        mk_state(), _train_stream(), _eval_stream
+    )
+    rows = [json.loads(line) for line in path.read_text().splitlines()]
+    assert [r["epoch"] for r in rows] == [1, 2]
+    for row in rows:
+        assert "train_loss" in row and "val_top1" in row
+        assert row["images_per_second"] > 0
+
+
 def test_fit_requires_steps_per_epoch(parts):
     mesh, _, train_step, _ = parts
     with pytest.raises(ValueError, match="steps_per_epoch"):
