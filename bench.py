@@ -383,6 +383,11 @@ def main() -> int:
         if args.model.startswith("bert"):
             args.batch_size, args.seq_len = 4, 32
 
+    from distributeddeeplearning_tpu.utils.hardware import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
     if args.devices:
         return _run_scaling(args)
     return _run_single(args)

@@ -163,6 +163,10 @@ def next_token_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     from distributeddeeplearning_tpu.train.step import cross_entropy_loss
 
     b, s = tokens.shape
+    if s < 2:
+        raise ValueError(
+            f"next-token loss needs sequence length >= 2, got {s}"
+        )
     shifted_logits = logits[:, :-1].reshape(b * (s - 1), -1)
     targets = tokens[:, 1:].reshape(b * (s - 1))
     return cross_entropy_loss(shifted_logits, targets)

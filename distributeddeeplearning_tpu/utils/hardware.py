@@ -48,6 +48,26 @@ def peak_bf16_flops(device: Optional[jax.Device] = None) -> Optional[float]:
     return None
 
 
+def enable_compilation_cache(min_compile_time_secs: int = 1) -> None:
+    """Persistent XLA compilation cache — repeated invocations of the same
+    program (driver runs, bench sweeps, dryruns) skip the multi-minute
+    recompile.  Best-effort: never fails the caller."""
+    import os
+
+    try:
+        cache = os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "jax",
+        )
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_compile_time_secs
+        )
+    except Exception:
+        pass
+
+
 def step_flops(compiled) -> Optional[float]:
     """Total FLOPs of one execution of a compiled XLA program.
 
