@@ -278,6 +278,7 @@ def _run_scaling(args) -> int:
     """Allreduce scaling-efficiency sweep over increasing mesh sizes."""
     from distributeddeeplearning_tpu.utils.virtual_pod import (
         force_cpu_platform_if_child,
+        is_reexec_child,
         reexec_with_virtual_pod,
     )
 
@@ -336,6 +337,10 @@ def _run_scaling(args) -> int:
                 "vs_baseline": efficiency[str(n_max)],
                 "img_sec_total": {str(n): round(v, 1) for n, v in totals.items()},
                 "efficiency": efficiency,
+                # A curve measured over faked CPU devices is a SHAPE check,
+                # not an ICI measurement — say which one this was.
+                "platform": jax.default_backend(),
+                "virtual_pod": is_reexec_child(),
             }
         )
     )

@@ -246,15 +246,22 @@ class Submitter:
                 "and resubmitting (%d/%d)",
                 run.run_id, attempts, state, attempts, max_retries,
             )
+            ship_dir = project_dir or self.settings.get("PROJECT_DIR", "")
+            if not ship_dir or ship_dir == ".":
+                # No recorded source tree: shipping the control process's cwd
+                # would scp + pip-install whatever happens to be there.
+                logger.error(
+                    "run %s: cannot re-bootstrap after preemption — "
+                    "PROJECT_DIR is unset (run `ddlt tpu bootstrap <dir>` "
+                    "first); giving up", run.run_id,
+                )
+                break
             try:
                 pod.recreate()
                 # Fresh VMs have nothing installed: re-run the bootstrap
                 # (scp + pip install) or the identical resubmit dies on
                 # import.  PROJECT_DIR names the source tree to ship.
-                self.bootstrap_pod(
-                    project_dir or self.settings.get("PROJECT_DIR", "."),
-                    pod=pod,
-                )
+                self.bootstrap_pod(ship_dir, pod=pod)
             except Exception as exc:  # capacity stockout, transient gcloud
                 # The run must never be stranded in "running": record the
                 # failure and stop retrying.

@@ -306,15 +306,23 @@ def _py_varint(buf: bytes, pos: int):
 
 
 def _py_skip(buf: bytes, pos: int, wire: int) -> int:
+    # Bounds-checked like the C walker: skipping past the end of the buffer
+    # is corruption, not "field absent".
     if wire == 0:
         _, pos = _py_varint(buf, pos)
         return pos
     if wire == 1:
+        if pos + 8 > len(buf):
+            raise RecordCorruptionError("truncated fixed64 field")
         return pos + 8
     if wire == 2:
         n, pos = _py_varint(buf, pos)
+        if n > len(buf) - pos:
+            raise RecordCorruptionError("truncated length-delimited field")
         return pos + n
     if wire == 5:
+        if pos + 4 > len(buf):
+            raise RecordCorruptionError("truncated fixed32 field")
         return pos + 4
     raise RecordCorruptionError(f"unknown wire type {wire}")
 
@@ -346,6 +354,10 @@ def _py_find_feature(record: bytes, key: str):
         field, wire = tag >> 3, tag & 7
         if field == 1 and wire == 2:
             n, pos = _py_varint(features, pos)
+            if n > len(features) - pos:
+                # Over-long map entry: not-found, matching _py_find_len_field
+                # and the C walker's contract.
+                return None
             entry = features[pos : pos + n]
             pos += n
             if _py_find_len_field(entry, 1) == kb:

@@ -289,6 +289,11 @@ class Trainer:
             row["images_per_second"] = (
                 cfg.steps_per_epoch * cfg.global_batch_size
             ) / max(epoch_train_wall, 1e-9)
+            if epoch == start_epoch:
+                # The first epoch's wall includes train_step JIT compilation
+                # (~20-40s on TPU); flag the row so nobody diffs it against
+                # later epochs or the benchmark harness numbers.
+                row["includes_compile"] = True
             self.metrics_log.append(row)
 
             if self.checkpointer is not None:
@@ -316,29 +321,41 @@ class Trainer:
 
         Per-host eval file shards can yield uneven batch counts; a host with
         extra batches would enter the eval-step collectives alone and hang
-        the pod.  All hosts therefore agree each iteration (all-gather of a
-        has-batch flag) before stepping, and stop together as soon as any
-        host runs dry.  Batches are weighted by size so ragged final batches
-        do not bias top-1.
+        the pod.  Hosts therefore agree ONCE per eval pass on a common batch
+        count — each host counts its available batches up front (buffering
+        them), the pod takes the minimum, and every host runs exactly that
+        many steps with no further host round-trips.  Batches are weighted by
+        size so ragged final batches do not bias top-1.
         """
         meters: Dict[str, AverageMeter] = {}
-        steps = 0
         multi_host = jax.process_count() > 1
+        limit = self.config.eval_steps
         if multi_host:
             from jax.experimental import multihost_utils
-        while True:
-            if self.config.eval_steps is not None and steps >= self.config.eval_steps:
-                break
-            batch = next(eval_batches, None)
-            if multi_host:
-                all_have = bool(
-                    multihost_utils.process_allgather(
-                        np.asarray(batch is not None)
-                    ).all()
-                )
-                if not all_have:
+
+            # Drain (up to eval_steps) locally first: eval epochs are small
+            # (ImageNet val = 50k images / pod) so buffering batch dicts of
+            # host numpy arrays is cheap, and it turns N allgathers into 1.
+            local: list = []
+            for batch in eval_batches:
+                local.append(batch)
+                if limit is not None and len(local) >= limit:
                     break
-            elif batch is None:
+            common = int(
+                multihost_utils.process_allgather(
+                    np.asarray(len(local))
+                ).min()
+            )
+            batches: Iterator[Batch] = iter(local[:common])
+            limit = common
+        else:
+            batches = eval_batches
+        steps = 0
+        while True:
+            if limit is not None and steps >= limit:
+                break
+            batch = next(batches, None)
+            if batch is None:
                 break
             batch_size = len(next(iter(batch.values())))
             metrics = self.eval_step(state, shard_batch(self.mesh, batch))

@@ -220,6 +220,7 @@ def submit_env(tmp_path):
     env_file.write_text(
         "GCS_BUCKET=bkt\nTPU_NAME=pod-a\nTPU_TYPE=v5litepod-16\n"
         "GCP_ZONE=us-west4-a\nEXPERIMENT_NAME=exp1\n"
+        f"PROJECT_DIR={tmp_path}\n"  # preemption retries refuse to ship cwd
     )
     cfg = load_config(env_file)
     runner = FakeRunner([(_describe_missing, CommandResult([], returncode=1))])
@@ -309,6 +310,22 @@ class TestSubmitter:
             for a in runner.history
             if "ssh" in a and "--command" in a
         )
+
+    def test_remote_retry_refuses_unset_project_dir(self, submit_env):
+        """Preempted pod but no recorded PROJECT_DIR → the retry must give
+        up rather than scp + pip-install whatever cwd the control process
+        happens to run from."""
+        cfg, _, registry = submit_env
+        cfg.persist("PROJECT_DIR", "")
+        runner = self._preemption_runner(
+            pod_state="PREEMPTED", fail_ssh_times=1
+        )
+        submitter = Submitter(cfg, runner, registry)
+        run = submitter.submit_remote(
+            "imagenet", {"data_format": "synthetic"}, max_retries=1
+        )
+        assert run.status == "failed"
+        assert not any("scp" in a for a in runner.history)
 
     def test_remote_no_retry_when_pod_ready(self, submit_env):
         """A workload failure on a healthy pod must NOT trigger recreate —
