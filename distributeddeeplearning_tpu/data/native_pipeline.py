@@ -130,14 +130,19 @@ def native_input_fn(
     all_files = shard_filenames(data_dir, is_training, num_shards)
     files = all_files[shard_index::shard_count]
     if not files:
-        # More hosts than shard files (e.g. 128 eval shards on a larger pod):
-        # with repeat=True this would busy-loop yielding nothing forever.
-        # Fail loudly, matching shard_filenames' philosophy.
-        raise ValueError(
-            f"host shard {shard_index}/{shard_count} has no files — only "
-            f"{len(all_files)} shard file(s) exist in {data_dir}; "
-            "shard_count must not exceed the number of shard files"
-        )
+        # More hosts than shard files (e.g. 128 eval shards on a larger pod).
+        if repeat:
+            # With repeat=True this would busy-loop yielding nothing forever.
+            # Fail loudly, matching shard_filenames' philosophy.
+            raise ValueError(
+                f"host shard {shard_index}/{shard_count} has no files — only "
+                f"{len(all_files)} shard file(s) exist in {data_dir}; "
+                "shard_count must not exceed the number of shard files"
+            )
+        # One-pass (eval) callers yield nothing: Trainer.evaluate's min-count
+        # handshake resolves a zero-batch host gracefully, whereas raising
+        # mid-drain would strand the other hosts at the allgather.
+        return
     decode = _decode_train if is_training else _decode_eval
     means = np.asarray(CHANNEL_MEANS, np.float32)
     rng = random.Random(seed)

@@ -84,22 +84,27 @@ def _cast_inputs(inputs: jax.Array, compute_dtype: jnp.dtype) -> jax.Array:
     return inputs.astype(compute_dtype)
 
 
-def _forward(state, params, inputs, train: bool, rngs=None, extras=None):
+def _forward(state, params, inputs, train: bool, rngs=None, extras=None,
+             batch_stats=None):
     """Apply the model, handling BN batch_stats models and stat-free models.
 
     Returns (logits, new_batch_stats, aux_loss) where ``aux_loss`` is the
     summed ``moe_losses`` collection (0.0 for models without MoE layers) —
     the Switch-style load-balance terms sown by ``models.moe.MoeMlp``.
+
+    ``batch_stats`` overrides ``state.batch_stats`` so microbatched callers
+    (gradient accumulation) can thread stats updated by earlier microbatches.
     """
     from distributeddeeplearning_tpu.models.moe import MOE_LOSS_COLLECTION
 
-    has_stats = bool(jax.tree_util.tree_leaves(state.batch_stats))
+    stats = state.batch_stats if batch_stats is None else batch_stats
+    has_stats = bool(jax.tree_util.tree_leaves(stats))
     variables = {"params": params}
     kwargs = dict(extras or {})
     if rngs:
         kwargs["rngs"] = rngs
     if has_stats:
-        variables["batch_stats"] = state.batch_stats
+        variables["batch_stats"] = stats
     if train:
         mutable = [MOE_LOSS_COLLECTION] + (["batch_stats"] if has_stats else [])
         logits, new_vars = state.apply_fn(
@@ -111,11 +116,11 @@ def _forward(state, params, inputs, train: bool, rngs=None, extras=None):
                 new_vars.get(MOE_LOSS_COLLECTION, {})
             )
         )
-        new_stats = new_vars.get("batch_stats", state.batch_stats)
+        new_stats = new_vars.get("batch_stats", stats)
         return logits, new_stats, jnp.asarray(aux, jnp.float32)
     kwargs.pop("rngs", None)
     logits = state.apply_fn(variables, inputs, train=False, **kwargs)
-    return logits, state.batch_stats, jnp.zeros((), jnp.float32)
+    return logits, stats, jnp.zeros((), jnp.float32)
 
 
 def _state_shardings(mesh, state_example, rules, logical_axes):
@@ -162,6 +167,7 @@ def build_train_step(
     loss_fn: Callable = cross_entropy_loss,
     rng: Optional[jax.Array] = None,
     moe_aux_weight: float = 0.01,  # Switch Transformer's α
+    accum_steps: int = 1,
 ) -> Callable:
     """Compile the full DP training step over ``mesh``.
 
@@ -173,7 +179,21 @@ def build_train_step(
 
     ``rng`` seeds per-step stochastic layers (dropout); each step folds the
     step counter in, so resume at step k reproduces step k's dropout mask.
+
+    ``accum_steps`` > 1 microbatches the step: the global batch is split into
+    ``accum_steps`` equal slices along the batch axis and a ``lax.scan``
+    accumulates the mean gradient before a SINGLE optimizer update — the
+    global-batch lever when per-chip memory caps the resident batch (the
+    reference's only lever was per-GPU batch × world size).  Activation
+    memory scales with the microbatch; parameter/optimizer memory is
+    unchanged.  For stat-free models the update is bitwise the same math as
+    one big batch (mean of per-microbatch mean-grads == full-batch mean
+    grad); BatchNorm models see ``accum_steps`` sequential EMA updates of
+    batch statistics over microbatch moments instead of one global-batch
+    moment — the standard, documented deviation.
     """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     b_shard = batch_sharding(mesh)
     r_shard = replicated(mesh)
     state_shardings = _state_shardings(mesh, state_example, rules or [], logical_axes)
@@ -183,29 +203,97 @@ def build_train_step(
         inputs = batch.get("image", batch.get("input"))
         labels = batch["label"]
         extras = {k: batch[k] for k in EXTRA_INPUT_KEYS if k in batch}
-        rngs = {"dropout": jax.random.fold_in(base_rng, state.step)}
+        step_rng = jax.random.fold_in(base_rng, state.step)
 
-        def compute_loss(params):
+        def compute_loss(params, stats, mb_inputs, mb_labels, mb_extras, rngs):
             logits, new_stats, aux = _forward(
                 state,
                 params,
-                _cast_inputs(inputs, compute_dtype),
+                _cast_inputs(mb_inputs, compute_dtype),
                 train=True,
                 rngs=rngs,
-                extras=extras,
+                extras=mb_extras,
+                batch_stats=stats,
             )
-            loss = loss_fn(logits, labels, label_smoothing=label_smoothing)
+            loss = loss_fn(logits, mb_labels, label_smoothing=label_smoothing)
             loss = loss + moe_aux_weight * aux
             return loss, (logits, new_stats)
 
-        (loss, (logits, new_stats)), grads = jax.value_and_grad(
-            compute_loss, has_aux=True
-        )(state.params)
-        new_state = state.apply_gradients(grads, batch_stats=new_stats)
-        # Aux-head models (InceptionV3 aux_logits=True) return (main, aux);
-        # metrics report on the main head only.
-        main_logits = logits[0] if isinstance(logits, tuple) else logits
-        metrics = classification_metrics(main_logits, labels, loss)
+        grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+
+        if accum_steps == 1:
+            (loss, (logits, new_stats)), grads = grad_fn(
+                state.params, state.batch_stats, inputs, labels, extras,
+                {"dropout": step_rng},
+            )
+            new_state = state.apply_gradients(grads, batch_stats=new_stats)
+            # Aux-head models (InceptionV3 aux_logits=True) return (main, aux);
+            # metrics report on the main head only.
+            main_logits = logits[0] if isinstance(logits, tuple) else logits
+            metrics = classification_metrics(main_logits, labels, loss)
+        else:
+            if inputs.shape[0] % accum_steps:
+                raise ValueError(
+                    f"global batch {inputs.shape[0]} not divisible by "
+                    f"accum_steps={accum_steps}"
+                )
+
+            def split(x):
+                # Interleaved split (row r -> microbatch r % accum_steps):
+                # the batch axis is contiguously sharded over the data mesh
+                # axes, so a contiguous [accum, B/accum] reshape would put
+                # each microbatch on 1/accum of the devices and force a
+                # resharding collective every scan iteration.  The strided
+                # assignment keeps every microbatch spread over ALL devices
+                # — each device scans over its own resident rows, zero data
+                # movement — and the accumulated mean over the global batch
+                # is identical either way.
+                return x.reshape(
+                    (x.shape[0] // accum_steps, accum_steps) + x.shape[1:]
+                ).swapaxes(0, 1)
+
+            micro = jax.tree_util.tree_map(
+                split, {"inputs": inputs, "labels": labels, "extras": extras}
+            )
+            zero_grads = jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=jnp.float32), state.params
+            )
+            zero_metrics = {
+                "loss": jnp.zeros((), jnp.float32),
+                "top1": jnp.zeros((), jnp.float32),
+                "top5": jnp.zeros((), jnp.float32),
+            }
+
+            def body(carry, xs):
+                grads_acc, stats, metrics_acc, i = carry
+                rngs = {"dropout": jax.random.fold_in(step_rng, i)}
+                (loss, (logits, stats)), grads = grad_fn(
+                    state.params, stats, xs["inputs"], xs["labels"],
+                    xs["extras"], rngs,
+                )
+                grads_acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
+                )
+                main_logits = logits[0] if isinstance(logits, tuple) else logits
+                mb_metrics = classification_metrics(
+                    main_logits, xs["labels"], loss
+                )
+                metrics_acc = jax.tree_util.tree_map(
+                    lambda a, m: a + m, metrics_acc, mb_metrics
+                )
+                return (grads_acc, stats, metrics_acc, i + 1), None
+
+            (grads_sum, new_stats, metrics_sum, _), _ = jax.lax.scan(
+                body,
+                (zero_grads, state.batch_stats, zero_metrics, jnp.zeros((), jnp.int32)),
+                micro,
+            )
+            inv = 1.0 / accum_steps
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g * inv).astype(p.dtype), grads_sum, state.params
+            )
+            new_state = state.apply_gradients(grads, batch_stats=new_stats)
+            metrics = jax.tree_util.tree_map(lambda m: m * inv, metrics_sum)
         if schedule is not None:
             metrics["lr"] = schedule(state.step).astype(jnp.float32)
         return new_state, metrics

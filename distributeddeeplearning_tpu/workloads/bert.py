@@ -87,6 +87,7 @@ def main(
     warmup_fraction: float = 0.1,
     weight_decay: float = 0.01,
     grad_clip_norm: float = 1.0,
+    accum_steps: int = 1,  # microbatched gradient accumulation (step.py)
     dropout_rate: float = 0.1,
     train_examples: Optional[int] = None,
     steps_per_epoch: Optional[int] = None,
@@ -233,6 +234,7 @@ def main(
     train_step = build_train_step(
         mesh, state, schedule=schedule, compute_dtype=dtype,
         rules=rules, logical_axes=axes, rng=jax.random.key(seed + 1),
+        accum_steps=accum_steps,
     )
     eval_step = build_eval_step(
         mesh, state, compute_dtype=dtype, rules=rules, logical_axes=axes

@@ -127,6 +127,7 @@ def main(
     weight_decay: float = 5e-5,  # imagenet_pytorch_horovod.py:43
     warmup_epochs: int = 5,
     label_smoothing: float = 0.0,
+    accum_steps: int = 1,  # microbatched gradient accumulation (step.py)
     image_size: int = 224,
     num_classes: int = NUM_CLASSES,
     save_filepath: Optional[str] = None,  # resnet_main.py model_dir analogue
@@ -200,7 +201,8 @@ def main(
     step_kwargs = {"loss_fn": loss_fn} if loss_fn is not None else {}
     train_step = build_train_step(
         mesh, state, schedule=schedule, label_smoothing=label_smoothing,
-        compute_dtype=dtype, rng=jax.random.key(seed + 1), **step_kwargs,
+        compute_dtype=dtype, rng=jax.random.key(seed + 1),
+        accum_steps=accum_steps, **step_kwargs,
     )
     eval_step = build_eval_step(mesh, state, compute_dtype=dtype)
 

@@ -222,3 +222,70 @@ def test_label_smoothing_changes_loss(mesh8):
     _, m_plain = plain(mk(), batch)
     _, m_smooth = smooth(mk(), batch)
     assert float(m_plain["loss"]) != float(m_smooth["loss"])
+
+
+def _bert_state_and_model(seed=0):
+    model = get_model(
+        "bert-base", num_layers=2, hidden_size=32, num_heads=2,
+        intermediate_size=64, vocab_size=50, num_classes=3,
+        max_position_embeddings=16, dropout_rate=0.0, dtype=jnp.float32,
+    )
+    tx = sgd_momentum(optax.constant_schedule(0.05))
+    state = create_train_state(
+        jax.random.key(seed), model, (2, 8), tx, input_dtype=jnp.int32
+    )
+    return state, model, tx
+
+
+def test_grad_accumulation_matches_full_batch(mesh8):
+    """accum_steps=4 on the same global batch computes the SAME update as
+    one full-batch step (stat-free model; VERDICT r02 item 5 contract)."""
+    rng = np.random.default_rng(7)
+    batch_np = {
+        "input": rng.integers(0, 50, (32, 8)).astype(np.int32),
+        "label": rng.integers(0, 3, (32,)).astype(np.int32),
+    }
+    batch = shard_batch(mesh8, batch_np)
+
+    state_a, _, _ = _bert_state_and_model()
+    step_a = build_train_step(mesh8, state_a, compute_dtype=jnp.float32)
+    state_a, m_a = step_a(state_a, batch)
+
+    state_b, _, _ = _bert_state_and_model()
+    step_b = build_train_step(
+        mesh8, state_b, compute_dtype=jnp.float32, accum_steps=4
+    )
+    state_b, m_b = step_b(state_b, batch)
+
+    np.testing.assert_allclose(float(m_a["loss"]), float(m_b["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        ),
+        state_a.params,
+        state_b.params,
+    )
+
+
+def test_grad_accumulation_batchnorm_model_trains(mesh8):
+    """BN models train under accumulation (sequential EMA stats updates)."""
+    state = _make_state()
+    step = build_train_step(
+        mesh8, state, compute_dtype=jnp.float32, accum_steps=2
+    )
+    batch = shard_batch(mesh8, synthetic_batch(16, IMG, NCLS))
+    state, first = step(state, batch)
+    for _ in range(4):
+        state, metrics = step(state, batch)
+    assert float(metrics["loss"]) < float(first["loss"])
+    assert int(state.step) == 5  # one optimizer update per step call
+
+
+def test_grad_accumulation_rejects_indivisible_batch(mesh8):
+    state = _make_state()
+    step = build_train_step(
+        mesh8, state, compute_dtype=jnp.float32, accum_steps=3
+    )
+    batch = shard_batch(mesh8, synthetic_batch(16, IMG, NCLS))
+    with pytest.raises(ValueError, match="not divisible"):
+        step(state, batch)
