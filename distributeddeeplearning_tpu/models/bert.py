@@ -51,6 +51,13 @@ class BertConfig:
     num_experts: int = 0
     moe_every_n: int = 2
     moe_capacity_factor: float = 1.25
+    # Rematerialization of encoder layers (jax.checkpoint): "none" stores
+    # every layer activation for the backward; "full" recomputes each layer
+    # in the backward (activation memory /= num_layers — the long-context
+    # relief valve alongside the flash-attention kernel); "dots" saves only
+    # matmul outputs (checkpoint_dots policy — a middle point that skips
+    # recomputing the MXU-bound ops).
+    remat: str = "none"
 
 
 BERT_BASE = BertConfig()
@@ -235,11 +242,26 @@ class BertEncoder(nn.Module):
         if attention_mask is not None:
             mask = attention_mask[:, None, None, :].astype(bool)
 
+        layer_cls = EncoderLayer
+        if cfg.remat != "none":
+            if cfg.remat == "full":
+                policy = None  # recompute everything in the backward
+            elif cfg.remat == "dots":
+                policy = jax.checkpoint_policies.checkpoint_dots
+            else:
+                raise ValueError(
+                    f"remat must be 'none', 'full' or 'dots', got {cfg.remat!r}"
+                )
+            # static_argnums counts the module instance as argument 0, so
+            # ``train`` (a Python bool steering dropout determinism) is 3.
+            layer_cls = nn.remat(
+                EncoderLayer, static_argnums=(3,), policy=policy
+            )
         for i in range(cfg.num_layers):
             use_moe = (
                 cfg.num_experts > 0 and (i + 1) % max(cfg.moe_every_n, 1) == 0
             )
-            x = EncoderLayer(
+            x = layer_cls(
                 cfg, self.dtype, self.attention_fn, use_moe=use_moe,
                 name=f"layer{i}",
             )(x, mask, train)

@@ -12,10 +12,14 @@ Grid: ``(batch*heads, q_blocks, k_blocks)`` with the k dimension
 carry across k blocks of the same q block.
 
 Differentiation: the kernel is wrapped in ``jax.custom_vjp`` — forward runs
-the Pallas kernel and saves the per-query logsumexp; backward recomputes
-attention weights from the logsumexp with plain XLA einsums (numerically
-exact, O(S²) memory in the backward only).  On non-TPU backends the kernel
-runs in Pallas interpret mode, so the op is testable on the CPU mesh.
+the Pallas kernel and saves the per-query logsumexp; backward is the
+FlashAttention-2 blocked scheme (Dao 2307.08691), also in Pallas: a dq pass
+(sequential over k blocks) and a dk/dv pass (sequential over q blocks), each
+recomputing the attention probabilities of one (q-block, k-block) tile from
+the saved logsumexp so nothing O(S²) ever materializes in HBM — training
+memory is O(S), which is what makes long-context *training* (not just
+inference) fit on a chip.  On non-TPU backends the kernels run in Pallas
+interpret mode, so the op is testable on the CPU mesh.
 
 ``make_flash_attention()`` returns an ``attention_fn`` drop-in for
 ``models.bert`` (same signature as ``dot_product_attention``).  The padding
@@ -135,6 +139,153 @@ def _flash_fwd_pallas(q3, k3, v3, bias2, *, heads: int, block_q: int,
     return o3, lse3[:, 0, :]
 
 
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, acc_ref, *, scale: float):
+    """dq pass: one q block resident, stream k/v blocks (grid dim 2)."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, 0]      # [bq]
+    delta = delta_ref[0, 0]  # [bq] = rowsum(dO ⊙ O)
+    s = (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        * scale
+        + bias_ref[0, 0][None, :]
+    )
+    p = jnp.exp(s - lse[:, None])  # exact probs from the saved logsumexp
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta[:, None]) * scale
+    acc_ref[:] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float):
+    """dk/dv pass: one k block resident, stream q blocks (grid dim 2).
+    Works transposed ([bk, bq] tiles) so the accumulators index by key."""
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, 0]      # [bq]
+    delta = delta_ref[0, 0]  # [bq]
+    st = (
+        jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        * scale
+        + bias_ref[0, 0][:, None]
+    )  # [bk, bq]
+    pt = jnp.exp(st - lse[None, :])
+    dv_acc[:] += jax.lax.dot_general(
+        pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dpt = jax.lax.dot_general(
+        v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [bk, bq]
+    dst = pt * (dpt - delta[None, :]) * scale
+    dk_acc[:] += jax.lax.dot_general(
+        dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q3, k3, v3, bias2, o3, lse, do3, *, heads: int,
+                      block_q: int, block_k: int):
+    """FlashAttention-2 backward: (dq, dk, dv), each [BH, S, D]."""
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError("pallas TPU support unavailable in this jax build")
+    bh, s, d = q3.shape
+    scale = 1.0 / (d ** 0.5)
+    # delta_i = Σ_d dO ⊙ O — one cheap O(S·D) elementwise reduce in XLA.
+    delta = jnp.sum(
+        do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1
+    )  # [BH, S]
+    bias3 = bias2[:, None, :]
+    lse3 = lse[:, None, :]
+    delta3 = delta[:, None, :]
+    compiler_params = None
+    if not _use_interpret():
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    bias_spec = pl.BlockSpec(
+        (1, 1, block_k), lambda b, i, j, heads=heads: (b // heads, 0, j)
+    )
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
+    dq3 = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale),
+        grid=(bh, s // block_q, s // block_k),
+        in_specs=[q_spec, k_spec, k_spec, bias_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=compiler_params,
+        interpret=_use_interpret(),
+    )(q3, k3, v3, bias3, do3, lse3, delta3)
+
+    # dk/dv pass: swap the roles — k blocks resident (grid dim 1), q blocks
+    # streamed (grid dim 2, sequential).
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    bias_spec2 = pl.BlockSpec(
+        (1, 1, block_k), lambda b, i, j, heads=heads: (b // heads, 0, i)
+    )
+    row_spec2 = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, j))
+    dk3, dv3 = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale),
+        grid=(bh, s // block_k, s // block_q),
+        in_specs=[
+            q_spec2, k_spec2, k_spec2, bias_spec2, q_spec2, row_spec2, row_spec2
+        ],
+        out_specs=[k_spec2, k_spec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=compiler_params,
+        interpret=_use_interpret(),
+    )(q3, k3, v3, bias3, do3, lse3, delta3)
+    return dq3, dk3, dv3
+
+
 def _make_core(heads: int, block_q: int, block_k: int, out_dtype):
     @jax.custom_vjp
     def core(q3, k3, v3, bias2):
@@ -153,28 +304,11 @@ def _make_core(heads: int, block_q: int, block_k: int, out_dtype):
 
     def bwd(res, do):
         q3, k3, v3, bias2, o, lse = res
-        d = q3.shape[-1]
-        scale = 1.0 / (d ** 0.5)
-        qf = q3.astype(jnp.float32)
-        kf = k3.astype(jnp.float32)
-        vf = v3.astype(jnp.float32)
-        dof = do.astype(jnp.float32)
-        of = o.astype(jnp.float32)
-        bias_bh = jnp.repeat(bias2, heads, axis=0)  # [BH, S]
-        s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale + bias_bh[:, None, :]
-        p = jnp.exp(s - lse[..., None])  # exact weights from saved logsumexp
-        dv = jnp.einsum("bqk,bqd->bkd", p, dof)
-        dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
-        delta = jnp.sum(dof * of, axis=-1, keepdims=True)
-        ds = p * (dp - delta)
-        dq = jnp.einsum("bqk,bkd->bqd", ds, kf) * scale
-        dk = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
-        return (
-            dq.astype(q3.dtype),
-            dk.astype(k3.dtype),
-            dv.astype(v3.dtype),
-            jnp.zeros_like(bias2),
+        dq, dk, dv = _flash_bwd_pallas(
+            q3, k3, v3, bias2, o, lse, do.astype(q3.dtype),
+            heads=heads, block_q=block_q, block_k=block_k,
         )
+        return dq, dk, dv, jnp.zeros_like(bias2)
 
     core.defvjp(fwd, bwd)
     return core

@@ -105,6 +105,7 @@ def main(
     seq: int = 1,
     expert: int = 1,
     attention: str = "auto",  # auto|default|flash|ring
+    remat: str = "none",  # none|full|dots — encoder-layer rematerialization
     num_experts: int = 0,  # >0 = MoE FFN in every 2nd layer (models/moe.py)
     # model-size overrides (tiny configs for tests/smoke)
     num_layers: Optional[int] = None,
@@ -173,6 +174,7 @@ def main(
         vocab_size=vocab_size,
         dropout_rate=dropout_rate,
         dtype=dtype,
+        remat=remat,
     )
     if num_experts:
         model_kwargs["num_experts"] = num_experts

@@ -146,3 +146,41 @@ def test_bert_encoder_with_flash_attention():
     np.testing.assert_allclose(
         np.asarray(out_fl), np.asarray(out_ref), atol=1e-4, rtol=1e-4
     )
+
+
+def test_gradients_asymmetric_blocks():
+    """The Pallas FA2 backward must be block-shape-agnostic (dq pass streams
+    k blocks; dk/dv pass streams q blocks — different grids)."""
+    q, k, v, mask = _inputs(5)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(
+            q, k, v, mask, dtype=jnp.float32, block_q=16, block_k=32
+        )
+        return (o ** 2).sum()
+
+    def loss_ref(q, k, v):
+        o = dot_product_attention(q, k, v, mask, dtype=jnp.float32)
+        return (o ** 2).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, rtol=5e-4
+        )
+
+
+def test_bf16_gradients_finite():
+    q, k, v, mask = _inputs(6, jnp.bfloat16)
+
+    def loss(q, k, v):
+        o = flash_attention(
+            q, k, v, mask, dtype=jnp.bfloat16, block_q=32, block_k=32
+        )
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in grads:
+        assert g.dtype == jnp.bfloat16
+        assert np.isfinite(np.asarray(g, np.float32)).all()
