@@ -193,8 +193,9 @@ def build_parser() -> argparse.ArgumentParser:
     ci_p.add_argument("--image-dir", default=None)
     ci_p.add_argument("--output", default=None,
                       help="Where to write imagenet_nounid_to_class.json")
-    ci_p.add_argument("--verify", default=None,
-                      help="Canonical keras-style class index JSON to check")
+    ci_p.add_argument("--verify", nargs="?", default=None, const="shipped",
+                      help="Canonical keras-style class index JSON to check "
+                      "(no value = the in-repo canonical file)")
     ci_p.add_argument("--label-offset", type=int, default=1,
                       help="1 (default) = this framework's 1001-class "
                       "background-head labels; 0 = the reference's 0-based "
@@ -210,6 +211,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     _add_submit_tree(sub, "imagenet")
     _add_submit_tree(sub, "bert", formats=("synthetic", "tfrecords"))
+    _add_submit_tree(sub, "transformer", formats=("synthetic",))
     _add_submit_tree(sub, "benchmark", formats=("synthetic",))
     _add_submit_tree(sub, "experiment", formats=())
 
@@ -229,7 +231,19 @@ def build_parser() -> argparse.ArgumentParser:
     runs_p.add_argument("--last", type=int, default=10)
     runs_p.add_argument(
         "--run", default=None,
-        help="Show one run's per-epoch metric rows (run.log_row role)",
+        help="Show one run: status + log tail + per-epoch metric rows",
+    )
+    runs_p.add_argument(
+        "--tail", type=int, default=20,
+        help="With --run: how many log lines to show (0 = none)",
+    )
+    runs_p.add_argument(
+        "--refresh", action="store_true",
+        help="With --run: probe the pod and flip a stale 'running' status",
+    )
+    runs_p.add_argument(
+        "--metrics-only", action="store_true",
+        help="With --run: print only the metrics JSONL rows (old behavior)",
     )
 
     sub.add_parser("experiments", help="List experiments in the run registry")
@@ -292,7 +306,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     parser = build_parser()
     args, extra = parser.parse_known_args(argv)
-    if extra and args.command not in ("imagenet", "bert", "benchmark", "experiment"):
+    if extra and args.command not in (
+        "imagenet", "bert", "transformer", "benchmark", "experiment"
+    ):
         parser.error(f"unrecognized arguments: {' '.join(extra)}")
 
     if args.command is None:
@@ -320,6 +336,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.command == "select-project":
         cfg, runner, _ = _control(args)
         project = args.project or cfg.get("GCP_PROJECT")
+        if not project and sys.stdin.isatty():
+            # Interactive chooser — ``inv select-subscription`` parity
+            # (``tasks.py:56-71``): tabulate the account's projects, prompt
+            # by number, persist the choice.
+            import json as _json
+
+            listing = runner.run(
+                ["gcloud", "projects", "list", "--format", "json"], check=False
+            )
+            try:
+                projects = _json.loads(listing.stdout or "[]")
+            except _json.JSONDecodeError:
+                projects = []
+            if projects:
+                print(f"{'#':<4}{'PROJECT_ID':<32}{'NAME':<28}")
+                print("-" * 64)
+                for i, p in enumerate(projects):
+                    print(
+                        f"{i:<4}{p.get('projectId', ''):<32}"
+                        f"{p.get('name', ''):<28}"
+                    )
+                choice = input("select project #: ").strip()
+                try:
+                    project = projects[int(choice)]["projectId"]
+                except (ValueError, IndexError):
+                    print(f"invalid selection {choice!r}", file=sys.stderr)
+                    return 1
         if not project:
             result = runner.run(
                 ["gcloud", "config", "get-value", "project"], check=False
@@ -352,7 +395,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_tpu(args)
     if args.command == "storage":
         return _cmd_storage(args)
-    if args.command in ("imagenet", "bert", "benchmark", "experiment"):
+    if args.command in (
+        "imagenet", "bert", "transformer", "benchmark", "experiment"
+    ):
         return _submit(args, args.command, extra)
     if args.command == "interactive":
         from distributeddeeplearning_tpu.control.tpu import pod_from_settings
@@ -366,15 +411,51 @@ def main(argv: Optional[List[str]] = None) -> int:
         cfg, _, registry = _control(args)
         experiment = args.experiment or cfg.get("EXPERIMENT_NAME") or "experiment"
         if args.run:
-            record = registry.find(experiment, args.run)
+            if getattr(args, "refresh", False):
+                from distributeddeeplearning_tpu.control.submit import Submitter
+
+                cfg2, runner2, registry = _control(args)
+                try:
+                    record = Submitter(cfg2, runner2, registry).poll_run(
+                        experiment, args.run
+                    )
+                except ValueError:
+                    record = None
+            else:
+                record = registry.find(experiment, args.run)
             path = (record.extra.get("metrics_path") if record else None) or str(
                 registry.run_dir_for(experiment, args.run) / "metrics.jsonl"
             )
             content = _read_text_maybe_gs(path)
-            if content is None:
-                print(f"no metrics recorded for {experiment}/{args.run}")
+            if getattr(args, "metrics_only", False):
+                if content is None:
+                    print(f"no metrics recorded for {experiment}/{args.run}")
+                    return 1
+                print(content.rstrip())
+                return 0
+            if record is None:
+                print(f"unknown run {experiment}/{args.run}")
                 return 1
-            print(content.rstrip())
+            print(
+                f"{record.experiment}/{record.run_id}: {record.workload} "
+                f"({record.mode}) status={record.status}"
+                + (f" rc={record.returncode}" if record.returncode is not None else "")
+            )
+            if record.extra.get("poll"):
+                print(f"  poll: {record.extra['poll']}")
+            tail_n = getattr(args, "tail", 20)
+            log_path = record.extra.get("log_path") or str(
+                registry.run_dir_for(experiment, args.run) / "log.txt"
+            )
+            log = _read_text_maybe_gs(log_path) if tail_n else None
+            if log:
+                lines = log.rstrip().splitlines()[-tail_n:]
+                print(f"--- log tail ({log_path}) ---")
+                for line in lines:
+                    print(line)
+            if content:
+                print("--- metrics ---")
+                print(content.rstrip())
             return 0
         print(registry.format_runs(experiment, args.last))
         return 0
@@ -552,15 +633,22 @@ def _cmd_storage(args) -> int:
         write_nounid_to_class(mapping, output)
         print(f"wrote {len(mapping)}-class mapping to {output}")
         if args.verify:
+            verify_path = args.verify
+            if verify_path == "shipped":
+                from distributeddeeplearning_tpu.data.class_index import (
+                    shipped_class_index_path,
+                )
+
+                verify_path = str(shipped_class_index_path())
             problems = verify_class_index(
-                load_class_index(args.verify), mapping,
+                load_class_index(verify_path), mapping,
                 label_offset=args.label_offset,
             )
             if problems:
                 for p in problems[:20]:
                     print(f"MISMATCH: {p}", file=sys.stderr)
                 return 1
-            print(f"verified against {args.verify}: OK")
+            print(f"verified against {verify_path}: OK")
         return 0
 
     if verb == "generate-tfrecords":

@@ -42,6 +42,7 @@ WORKLOAD_MODULES = {
     "imagenet": "distributeddeeplearning_tpu.workloads.imagenet",
     "benchmark": "distributeddeeplearning_tpu.workloads.benchmark",
     "bert": "distributeddeeplearning_tpu.workloads.bert",
+    "transformer": "distributeddeeplearning_tpu.workloads.transformer",
     "experiment": "distributeddeeplearning_tpu.workloads.experiment",
 }
 
@@ -229,8 +230,16 @@ class Submitter:
         command = shlex.join(argv)
         if max_retries is None:
             max_retries = int(self.settings.get("MAX_RETRIES", "0") or 0)
+        # Live output: the fan-out's stdout/stderr streams to the operator's
+        # console AND <run_dir>/log.txt as the job runs (the reference's
+        # wait_for_completion(show_output=True), aml_compute.py:391-392) —
+        # retries append to the same log.
+        log_path = str(self.registry.run_dir(run) / "log.txt")
+        run.extra["log_path"] = log_path
         self.registry.update(run, status="running")
-        result = pod.ssh(command, worker="all", env=env, check=False)
+        result = pod.ssh(
+            command, worker="all", env=env, check=False, stream_to=log_path
+        )
         attempts = 1
         while not result.ok and attempts <= max_retries:
             state = pod.state()
@@ -270,7 +279,9 @@ class Submitter:
                     run.run_id, exc,
                 )
                 break
-            result = pod.ssh(command, worker="all", env=env, check=False)
+            result = pod.ssh(
+                command, worker="all", env=env, check=False, stream_to=log_path
+            )
             attempts += 1
         if not result.ok:
             tail = (result.stderr or result.stdout or "").strip()[-2000:]
@@ -285,6 +296,62 @@ class Submitter:
             status="completed" if result.ok else "failed",
             returncode=result.returncode,
         )
+        return run
+
+    def poll_run(
+        self,
+        experiment: str,
+        run_id: str,
+        *,
+        pod: Optional[TpuPod] = None,
+    ) -> Run:
+        """Refresh a run's registry status by probing the pod.
+
+        The role of the reference's service-side Run status (AML tracks it;
+        ``tasks.py`` ``runs`` lists it).  Here the submit process itself
+        normally flips the status when the synchronous fan-out returns — but
+        if the control process died (laptop closed, tmux killed), the run is
+        stranded in ``running``.  The poll asks worker 0 whether the
+        workload's launcher module is still alive and flips the registry
+        accordingly; completed/failed runs are returned untouched.
+        """
+        run = self.registry.find(experiment, run_id)
+        if run is None:
+            raise ValueError(f"unknown run {experiment}/{run_id}")
+        if run.status != "running" or run.mode != "remote":
+            return run
+        module = WORKLOAD_MODULES.get(run.workload, run.workload)
+        pod = pod or pod_from_settings(self.settings, self.runner)
+        state = pod.state()
+        if state != "READY":
+            run.extra["poll"] = f"pod state {state}"
+            self.registry.update(run, status="failed")
+            return run
+        # Bracket the pattern's first char so pgrep cannot match the probe's
+        # own wrapping shell (whose cmdline also contains the module name).
+        pattern = f"[{module[0]}]{module[1:]}"
+        probe = pod.ssh(
+            f"pgrep -f '{pattern}' >/dev/null && echo ALIVE || echo DEAD",
+            worker="0",
+            check=False,
+        )
+        out = probe.stdout or ""
+        if "ALIVE" in out:
+            return run  # genuinely still training
+        if not probe.ok or "DEAD" not in out:
+            # The PROBE failed (ssh blip, key propagation) — that says
+            # nothing about the workload; never flip a live run on it.
+            logger.warning(
+                "run %s: status probe inconclusive (rc=%d); leaving status "
+                "as-is", run.run_id, probe.returncode,
+            )
+            return run
+        # Confirmed: no launcher process.  The run ended without this
+        # registry hearing about it.  Without an exit code the safe claim is
+        # "failed" — a completed run's submit process would have recorded
+        # completion.
+        run.extra["poll"] = "no launcher process on worker 0"
+        self.registry.update(run, status="failed")
         return run
 
     def bootstrap_pod(

@@ -165,6 +165,7 @@ def build_train_step(
     rules=None,
     logical_axes: Optional[PyTree] = None,
     loss_fn: Callable = cross_entropy_loss,
+    metrics_fn: Callable = classification_metrics,
     rng: Optional[jax.Array] = None,
     moe_aux_weight: float = 0.01,  # Switch Transformer's α
     accum_steps: int = 1,
@@ -230,7 +231,7 @@ def build_train_step(
             # Aux-head models (InceptionV3 aux_logits=True) return (main, aux);
             # metrics report on the main head only.
             main_logits = logits[0] if isinstance(logits, tuple) else logits
-            metrics = classification_metrics(main_logits, labels, loss)
+            metrics = metrics_fn(main_logits, labels, loss)
         else:
             if inputs.shape[0] % accum_steps:
                 raise ValueError(
@@ -258,14 +259,9 @@ def build_train_step(
             zero_grads = jax.tree_util.tree_map(
                 lambda p: jnp.zeros_like(p, dtype=jnp.float32), state.params
             )
-            zero_metrics = {
-                "loss": jnp.zeros((), jnp.float32),
-                "top1": jnp.zeros((), jnp.float32),
-                "top5": jnp.zeros((), jnp.float32),
-            }
 
             def body(carry, xs):
-                grads_acc, stats, metrics_acc, i = carry
+                grads_acc, stats, i = carry
                 rngs = {"dropout": jax.random.fold_in(step_rng, i)}
                 (loss, (logits, stats)), grads = grad_fn(
                     state.params, stats, xs["inputs"], xs["labels"],
@@ -275,17 +271,12 @@ def build_train_step(
                     lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
                 )
                 main_logits = logits[0] if isinstance(logits, tuple) else logits
-                mb_metrics = classification_metrics(
-                    main_logits, xs["labels"], loss
-                )
-                metrics_acc = jax.tree_util.tree_map(
-                    lambda a, m: a + m, metrics_acc, mb_metrics
-                )
-                return (grads_acc, stats, metrics_acc, i + 1), None
+                mb_metrics = metrics_fn(main_logits, xs["labels"], loss)
+                return (grads_acc, stats, i + 1), mb_metrics
 
-            (grads_sum, new_stats, metrics_sum, _), _ = jax.lax.scan(
+            (grads_sum, new_stats, _), metrics_stack = jax.lax.scan(
                 body,
-                (zero_grads, state.batch_stats, zero_metrics, jnp.zeros((), jnp.int32)),
+                (zero_grads, state.batch_stats, jnp.zeros((), jnp.int32)),
                 micro,
             )
             inv = 1.0 / accum_steps
@@ -293,7 +284,9 @@ def build_train_step(
                 lambda g, p: (g * inv).astype(p.dtype), grads_sum, state.params
             )
             new_state = state.apply_gradients(grads, batch_stats=new_stats)
-            metrics = jax.tree_util.tree_map(lambda m: m * inv, metrics_sum)
+            metrics = jax.tree_util.tree_map(
+                lambda m: m.mean(axis=0), metrics_stack
+            )
         if schedule is not None:
             metrics["lr"] = schedule(state.step).astype(jnp.float32)
         return new_state, metrics
@@ -313,6 +306,8 @@ def build_eval_step(
     compute_dtype: jnp.dtype = jnp.bfloat16,
     rules=None,
     logical_axes: Optional[PyTree] = None,
+    loss_fn: Callable = cross_entropy_loss,
+    metrics_fn: Callable = classification_metrics,
 ) -> Callable:
     """Compile the eval step: forward + loss/top1/top5, no state mutation
     (parity with ``validate`` at ``imagenet_pytorch_horovod.py:203-230`` and
@@ -333,8 +328,8 @@ def build_eval_step(
             train=False,
             extras=extras,
         )
-        loss = cross_entropy_loss(logits, labels)
-        return classification_metrics(logits, labels, loss)
+        loss = loss_fn(logits, labels)
+        return metrics_fn(logits, labels, loss)
 
     return jax.jit(
         step_fn,

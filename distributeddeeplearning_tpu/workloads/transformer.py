@@ -1,0 +1,264 @@
+"""Causal-LM transformer workload — the pipeline-parallel (`pipe`) consumer.
+
+The reference has no pipeline parallelism or LM workload at all (Horovod DP
+over CNNs only — SURVEY.md §2 "Parallelism strategies"); this workload makes
+the framework's sixth mesh axis launchable end-to-end:
+
+    ddlt transformer submit local synthetic --pipe 2 --num_microbatches 8
+
+trains :mod:`models.pipelined_transformer` — a stack of identical pre-LN
+blocks with parameters stacked ``[L, ...]`` — with the stages GPipe-scheduled
+over the ``pipe`` axis (:func:`ops.pipeline.pipeline_apply`), driven by the
+SAME Trainer/checkpoint/metrics machinery as every other workload: the
+stacked-param pytree rides an ordinary ``TrainState``, the stage dim shards
+over ``pipe`` via a one-rule logical-axis tree, and orbax checkpoints/resume
+work unchanged.  ``--pipe 1`` degrades to a plain scan-over-layers LM, so the
+workload also serves as the framework's single-chip LM trainer.
+
+Data is synthetic next-token streams (the LM analogue of the reference's
+synthetic benchmark mode, ``data/synthetic.py:4-52``): fixed-seed random
+token sequences, loss = shifted cross-entropy.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterator, Optional
+
+logger = logging.getLogger("ddlt.workloads.transformer")
+
+
+def _token_batches(
+    per_host_batch: int,
+    seq_len: int,
+    vocab_size: int,
+    seed: int,
+    length: int,
+    repeat: bool,
+) -> Iterator:
+    """Deterministic synthetic LM batches: {"input": toks, "label": toks}.
+
+    The label IS the input — the causal shift happens inside the loss
+    (models/pipelined_transformer.next_token_loss)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n_batches = max(length // per_host_batch, 1)
+    epoch = [
+        rng.integers(0, vocab_size, (per_host_batch, seq_len)).astype(np.int32)
+        for _ in range(n_batches)
+    ]
+    while True:
+        for toks in epoch:
+            yield {"input": toks, "label": toks}
+        if not repeat:
+            return
+
+
+def main(
+    *,
+    epochs: int = 3,
+    batch_size: int = 8,  # per chip
+    seq_len: int = 128,
+    vocab_size: int = 1031,
+    num_layers: int = 8,
+    d_model: int = 256,
+    num_heads: int = 8,
+    d_ff: int = 1024,
+    base_lr: float = 3e-4,
+    warmup_fraction: float = 0.1,
+    weight_decay: float = 0.01,
+    grad_clip_norm: float = 1.0,
+    accum_steps: int = 1,
+    train_examples: Optional[int] = None,
+    steps_per_epoch: Optional[int] = None,
+    save_filepath: Optional[str] = None,
+    tensorboard_dir: Optional[str] = None,
+    resume: bool = True,
+    profile_dir: Optional[str] = None,
+    metrics_path: Optional[str] = None,
+    seed: int = 42,
+    compute_dtype: str = "bfloat16",
+    distributed: Optional[bool] = None,
+    data_format: str = "synthetic",  # LM data is synthetic-only (see module doc)
+    # parallelism geometry: pipeline stages × data parallelism (remainder)
+    pipe: int = 1,
+    num_microbatches: int = 8,
+):
+    """Train; returns (state, FitResult)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        forward,
+        forward_pipelined,
+        init_params,
+        next_token_loss,
+    )
+    from distributeddeeplearning_tpu.parallel import (
+        MeshSpec,
+        create_mesh,
+        initialize,
+    )
+    from distributeddeeplearning_tpu.train.loop import Trainer, TrainerConfig
+    from distributeddeeplearning_tpu.train.schedule import (
+        warmup_linear_decay_schedule,
+    )
+    from distributeddeeplearning_tpu.train.state import TrainState, adamw
+    from distributeddeeplearning_tpu.train.step import (
+        build_eval_step,
+        build_train_step,
+        topk_correct,
+    )
+
+    if data_format != "synthetic":
+        raise ValueError(
+            "the transformer LM workload is synthetic-data only "
+            f"(got data_format={data_format!r})"
+        )
+    if num_layers % max(pipe, 1):
+        raise ValueError(
+            f"num_layers {num_layers} not divisible by pipe {pipe}"
+        )
+    ctx = initialize(force=distributed)
+    mesh = create_mesh(MeshSpec(pipe=pipe))
+    data_shards = mesh.shape["data"] * mesh.shape["fsdp"]
+    global_batch = batch_size * data_shards
+    per_host_batch = global_batch // ctx.process_count
+    if pipe > 1 and (global_batch // data_shards) % num_microbatches:
+        raise ValueError(
+            f"per-data-shard batch {global_batch // data_shards} not "
+            f"divisible by num_microbatches {num_microbatches}"
+        )
+    dtype = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
+
+    n_train = train_examples or 25_000
+    spe = steps_per_epoch or max(n_train // global_batch, 1)
+    total_steps = spe * epochs
+
+    if ctx.is_primary:
+        logger.info(
+            "training %d-layer LM: %d chips (pipe=%d data=%d), global batch "
+            "%d, %d microbatches, %d steps/epoch, %d epochs",
+            num_layers, mesh.devices.size, pipe, mesh.shape["data"],
+            global_batch, num_microbatches if pipe > 1 else 1, spe, epochs,
+        )
+
+    params = init_params(
+        jax.random.key(seed),
+        num_layers=num_layers,
+        d_model=d_model,
+        num_heads=num_heads,
+        d_ff=d_ff,
+        vocab_size=vocab_size,
+        max_len=seq_len,
+    )
+
+    def apply_fn(variables, tokens, train=True, mutable=None, rngs=None):
+        p = jax.tree_util.tree_map(
+            lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating)
+            else a,
+            variables["params"],
+        )
+        if pipe > 1:
+            logits = forward_pipelined(
+                p, tokens, num_heads=num_heads, mesh=mesh,
+                num_microbatches=num_microbatches,
+            )
+        else:
+            logits = forward(p, tokens, num_heads=num_heads)
+        logits = logits.astype(jnp.float32)
+        if mutable is not None:
+            return logits, {}
+        return logits
+
+    schedule = warmup_linear_decay_schedule(
+        base_lr, total_steps, warmup_fraction=warmup_fraction
+    )
+    tx = adamw(
+        schedule, weight_decay=weight_decay, grad_clip_norm=grad_clip_norm
+    )
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        batch_stats={},
+        apply_fn=apply_fn,
+        tx=tx,
+    )
+
+    # One rule: the stacked layer dim shards over pipe (contiguous stages —
+    # exactly the [S, L/S] reshape forward_pipelined performs); everything
+    # else replicates.
+    rules = [("layers", "pipe")]
+    logical_axes = {
+        "embed": None,
+        "pos": None,
+        "head": None,
+        "blocks": jax.tree_util.tree_map(
+            lambda a: ("layers",) + (None,) * (a.ndim - 1), params["blocks"]
+        ),
+    }
+
+    def lm_loss(logits, labels, *, label_smoothing: float = 0.0):
+        del label_smoothing  # the LM loss has no smoothing knob
+        return next_token_loss(logits, labels)
+
+    def lm_metrics(logits, tokens, loss):
+        b, s = tokens.shape
+        flat = logits[:, :-1].reshape(b * (s - 1), -1)
+        targets = tokens[:, 1:].reshape(b * (s - 1))
+        return {
+            "loss": loss.astype(jnp.float32),
+            "top1": topk_correct(flat, targets, 1),
+            "perplexity": jnp.exp(loss).astype(jnp.float32),
+        }
+
+    train_step = build_train_step(
+        mesh, state, schedule=schedule, compute_dtype=dtype,
+        rules=rules, logical_axes=logical_axes,
+        loss_fn=lm_loss, metrics_fn=lm_metrics,
+        rng=jax.random.key(seed + 1), accum_steps=accum_steps,
+    )
+    eval_step = build_eval_step(
+        mesh, state, compute_dtype=dtype, rules=rules,
+        logical_axes=logical_axes, loss_fn=lm_loss, metrics_fn=lm_metrics,
+    )
+
+    train_iter = _token_batches(
+        per_host_batch, seq_len, vocab_size, seed + ctx.process_index,
+        n_train, repeat=True,
+    )
+
+    def eval_factory():
+        return _token_batches(
+            per_host_batch, seq_len, vocab_size,
+            seed + 7000 + ctx.process_index,
+            min(n_train, 4 * global_batch), repeat=False,
+        )
+
+    trainer = Trainer(
+        mesh,
+        train_step,
+        eval_step=eval_step,
+        config=TrainerConfig(
+            epochs=epochs,
+            steps_per_epoch=spe,
+            global_batch_size=global_batch,
+            checkpoint_dir=save_filepath,
+            tensorboard_dir=tensorboard_dir,
+            resume=resume,
+            profile_dir=profile_dir,
+            metrics_path=metrics_path,
+        ),
+    )
+    return trainer.fit(state, train_iter, eval_factory)
+
+
+if __name__ == "__main__":
+    import logging as _logging
+
+    _logging.basicConfig(level=_logging.INFO)
+    from distributeddeeplearning_tpu.workloads._runner import run_from_argv
+
+    run_from_argv(main)
