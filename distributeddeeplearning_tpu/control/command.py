@@ -59,26 +59,82 @@ class CommandRunner:
         capture: bool = True,
         env: Optional[Dict[str, str]] = None,
         timeout: Optional[float] = None,
+        stream_to: Optional[str] = None,
     ) -> CommandResult:
+        """Execute ``argv``.
+
+        ``stream_to`` tees the command's merged stdout/stderr LIVE to both
+        the operator's console and the named log file (the reference's
+        ``wait_for_completion(show_output=True)`` role,
+        ``aml_compute.py:391-392``) — a multi-hour remote run scrolls its
+        epochs instead of printing nothing until exit.  The returned
+        ``CommandResult.stdout`` carries the tail of the stream so failure
+        paths can still report context.
+        """
         argv = [str(a) for a in argv]
         self.history.append(argv)
         if self.dry_run:
             print(f"[dry-run] {shlex.join(argv)}")
             return CommandResult(argv=argv, returncode=0)
         logger.debug("exec: %s", shlex.join(argv))
-        proc = subprocess.run(
+        if stream_to is not None:
+            if timeout is not None:
+                # The line-by-line tee loop has no read deadline; silently
+                # dropping a requested bound would be worse than refusing.
+                raise ValueError("timeout is not supported with stream_to")
+            result = self._run_streaming(argv, stream_to, env=env)
+        else:
+            proc = subprocess.run(
+                argv,
+                capture_output=capture,
+                text=True,
+                env=env,
+                timeout=timeout,
+            )
+            result = CommandResult(
+                argv=argv,
+                returncode=proc.returncode,
+                stdout=proc.stdout or "",
+                stderr=proc.stderr or "",
+            )
+        if check and not result.ok:
+            raise CommandError(argv, result.returncode, result.stdout, result.stderr)
+        return result
+
+    _STREAM_TAIL_CHARS = 8192
+
+    def _run_streaming(
+        self,
+        argv: List[str],
+        stream_to: str,
+        *,
+        env: Optional[Dict[str, str]] = None,
+    ) -> CommandResult:
+        import sys
+        from collections import deque
+        from pathlib import Path
+
+        log_path = Path(stream_to)
+        log_path.parent.mkdir(parents=True, exist_ok=True)
+        tail: deque = deque(maxlen=256)
+        with subprocess.Popen(
             argv,
-            capture_output=capture,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
             text=True,
             env=env,
-            timeout=timeout,
-        )
-        result = CommandResult(
+            bufsize=1,  # line buffered
+        ) as proc, open(log_path, "a") as log:
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                sys.stdout.write(line)
+                sys.stdout.flush()
+                log.write(line)
+                log.flush()
+                tail.append(line)
+            returncode = proc.wait()
+        return CommandResult(
             argv=argv,
-            returncode=proc.returncode,
-            stdout=proc.stdout or "",
-            stderr=proc.stderr or "",
+            returncode=returncode,
+            stdout="".join(tail)[-self._STREAM_TAIL_CHARS:],
         )
-        if check and not result.ok:
-            raise CommandError(argv, proc.returncode, result.stdout, result.stderr)
-        return result

@@ -152,6 +152,7 @@ class TpuPod:
         worker: str = "all",
         env: Optional[Dict[str, str]] = None,
         check: bool = True,
+        stream_to: Optional[str] = None,
     ):
         """Run ``command`` on pod workers — the per-host launcher fan-out
         that replaces ``mpirun`` (``aml_compute.py:128`` distributed_backend).
@@ -159,6 +160,9 @@ class TpuPod:
         ``env`` is injected as ``KEY=VALUE`` exports prefixed to the command,
         the analogue of the estimator's environment-variable injection
         (``DISTRIBUTED=True`` etc., ``aml_compute.py:86-90``).
+
+        ``stream_to`` tees the fan-out's output live to console + log file
+        (gcloud multiplexes all workers' stdout onto the one ssh stream).
         """
         if env:
             import shlex
@@ -171,6 +175,7 @@ class TpuPod:
             self._base("ssh", self.name)
             + ["--zone", self.zone, "--worker", str(worker), "--command", command],
             check=check,
+            stream_to=stream_to,
         )
 
     def interactive(self, *, worker: str = "0"):
