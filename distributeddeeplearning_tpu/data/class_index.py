@@ -8,10 +8,12 @@ The reference ships two label-mapping data files with fixed formats:
 - ``scripts/imagenet_class_index.json`` — ``{"0": ["n01440764", "tench"], …}``
   (the canonical keras-style human-readable index).
 
-We do not vendor those files — the first is fully derivable from the data
-tree (class labels ARE the sorted wnid directory order, which is also what
-``data/images.py`` and the TFRecord converter assume), and the second ships
-with every ImageNet distribution.  Instead this module:
+Both ship in-repo under ``data/files/`` (``shipped_class_index_path`` /
+``shipped_nounid_to_class_path``) so ``--verify`` works out of the box: the
+class index is the canonical public Keras/ImageNet metadata (1000 classes in
+sorted-wnid order with human-readable names), and the nounid→class object is
+derived from it (sorted wnid position, 0-based — the reference's format).
+This module additionally:
 
 - ``build_nounid_to_class(image_dir)`` derives the wnid→training-label
   mapping from the extracted train tree (1-based by default — what this
@@ -30,6 +32,19 @@ from __future__ import annotations
 import json
 from pathlib import Path
 from typing import Dict, List, Mapping, Sequence, Tuple
+
+
+_FILES_DIR = Path(__file__).parent / "files"
+
+
+def shipped_class_index_path() -> Path:
+    """The in-repo canonical ``imagenet_class_index.json``."""
+    return _FILES_DIR / "imagenet_class_index.json"
+
+
+def shipped_nounid_to_class_path() -> Path:
+    """The in-repo 0-based ``imagenet_nounid_to_class.json``."""
+    return _FILES_DIR / "imagenet_nounid_to_class.json"
 
 
 def list_wnids(image_dir: str | Path) -> List[str]:
