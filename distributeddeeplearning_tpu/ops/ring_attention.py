@@ -38,26 +38,42 @@ def _ring_body(q, k, v, mask, *, axis_name: str, ring: int, out_dtype):
     """Per-shard blockwise attention with rotating k/v (runs in shard_map).
 
     Shapes (local shard): q ``[B, Sq, H, D]``; k, v ``[B, Skv, H, D]``;
-    mask ``[B, 1, 1, Skv]`` bool (True = attend).  The ring is unrolled as a
-    Python loop (``ring`` is the static mesh axis size): every iteration is
-    reverse-mode differentiable and XLA overlaps each block's ppermute with
+    mask ``[B, 1, 1, Skv]`` bool (True = attend).  The ring is a
+    ``lax.scan`` over the rotation count — program size and compile time
+    are CONSTANT in the ring size (a pod-scale seq axis of 16 compiles the
+    same one-block body as a ring of 2), and every iteration is
+    reverse-mode differentiable.  XLA overlaps each block's ppermute with
     the previous block's matmuls.
+
+    Only k/v rotate.  The key-padding mask is all-gathered ONCE (bool
+    ``[B, 1, 1, S]`` — bits, not activations) and indexed by each step's
+    source rank, replacing a third per-step ppermute buffer.
     """
     depth = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(depth, jnp.float32))
     b, sq, h, _ = q.shape
+    skv = k.shape[1]
 
-    m = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
-    l = jnp.zeros((b, h, sq), jnp.float32)
-    o = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    o0 = jnp.zeros(q.shape, jnp.float32)
     perm = [(j, (j + 1) % ring) for j in range(ring)]
+    rank = jax.lax.axis_index(axis_name)
+    mask_all = jax.lax.all_gather(
+        mask, axis_name, axis=3, tiled=True
+    )  # [B, 1, 1, S]
 
-    for step in range(ring):
+    def step_fn(carry, r):
+        k, v, m, l, o = carry
+        # after r rotations this device holds the block that started on
+        # rank (rank - r) mod ring; slice that block's key-padding mask
+        src = jax.lax.rem(rank - r + ring, ring)
+        mask_r = jax.lax.dynamic_slice_in_dim(mask_all, src * skv, skv, axis=3)
         scores = (
             jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
             * scale
         )
-        scores = jnp.where(mask, scores, _NEG_BIG)
+        scores = jnp.where(mask_r, scores, _NEG_BIG)
         m_new = jnp.maximum(m, scores.max(axis=-1))
         p = jnp.exp(scores - m_new[..., None])
         correction = jnp.exp(m - m_new)
@@ -65,11 +81,15 @@ def _ring_body(q, k, v, mask, *, axis_name: str, ring: int, out_dtype):
         o = o * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
             "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
         )
-        m = m_new
-        if step + 1 < ring:  # last rotation would be a no-op round trip
-            k = jax.lax.ppermute(k, axis_name, perm)
-            v = jax.lax.ppermute(v, axis_name, perm)
-            mask = jax.lax.ppermute(mask, axis_name, perm)
+        # Unconditional rotation (uniform scan body; the final one returns
+        # k/v to their home shard, so the op leaves no residual rotation).
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        return (k, v, m_new, l, o), None
+
+    (_, _, m, l, o), _ = jax.lax.scan(
+        step_fn, (k, v, m0, l0, o0), jnp.arange(ring)
+    )
 
     l = jnp.maximum(l, 1e-30)  # fully-masked rows (all-padding) stay finite
     o = o / l.transpose(0, 2, 1)[..., None]

@@ -110,3 +110,31 @@ def test_bf16_output_dtype(qkv, padding_mask):
         dtype=jnp.bfloat16,
     )
     assert out.dtype == jnp.bfloat16
+
+
+def test_ring_program_size_constant_in_ring(monkeypatch):
+    """The scan-ified ring (VERDICT r02 item 8): the traced program must
+    contain ONE ppermute-carrying loop body regardless of ring size — a
+    Python-unrolled ring would grow ppermute count (and compile time)
+    linearly with the seq axis."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearning_tpu.ops.ring_attention import ring_attention
+    from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh
+
+    def count_ppermutes(ring):
+        mesh = create_mesh(MeshSpec(seq=ring))
+        b, s, h, d = 8, 8 * ring, 2, 4
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+
+        def f(q):
+            return ring_attention(q, q, q, None, mesh=mesh, dtype=jnp.float32)
+
+        return str(jax.make_jaxpr(f)(q)).count("ppermute")
+
+    n2, n8 = count_ppermutes(2), count_ppermutes(8)
+    assert n2 == n8, (n2, n8)
+    assert n8 <= 2  # k and v inside one scan body, nothing else
