@@ -137,7 +137,7 @@ def test_runs_show_metrics_rows(project, capsys):
 
     rc = main(["runs", "--run", "nope"])
     assert rc == 1
-    assert "no metrics recorded" in capsys.readouterr().out
+    assert "unknown run" in capsys.readouterr().out
 
 
 def test_dry_run_storage_and_tpu_verbs(project, capsys):
@@ -225,3 +225,59 @@ def test_run_from_argv_signature_checking():
     assert run_from_argv(target, ["--epochs", "4", "--name", "z"]) == (4, "z")
     with pytest.raises(SystemExit, match="unknown flag"):
         run_from_argv(target, ["--nope", "1"])
+
+
+def test_select_project_interactive_chooser(project, capsys, monkeypatch):
+    """inv select-subscription parity (tasks.py:56-71): tabulate the
+    account's projects, prompt by number, persist the pick to .env."""
+    import json
+
+    from distributeddeeplearning_tpu.control.command import (
+        CommandResult,
+        CommandRunner,
+    )
+
+    calls = []
+
+    def fake_run(self, argv, **kwargs):
+        argv = [str(a) for a in argv]
+        calls.append(argv)
+        if "projects" in argv and "list" in argv:
+            listing = [
+                {"projectId": "proj-alpha", "name": "Alpha"},
+                {"projectId": "proj-beta", "name": "Beta"},
+            ]
+            return CommandResult(argv=argv, returncode=0, stdout=json.dumps(listing))
+        return CommandResult(argv=argv, returncode=0)
+
+    monkeypatch.setattr(CommandRunner, "run", fake_run)
+    monkeypatch.setattr("sys.stdin.isatty", lambda: True)
+    monkeypatch.setattr("builtins.input", lambda prompt="": "1")
+    assert main(["select-project"]) == 0
+    out = capsys.readouterr().out
+    assert "proj-alpha" in out and "proj-beta" in out  # tabulated listing
+    assert "GCP_PROJECT=proj-beta" in (project / ".env").read_text()
+    assert any("set" in a and "proj-beta" in a for a in calls)
+
+
+def test_select_project_invalid_choice_errors(project, monkeypatch, capsys):
+    import json
+
+    from distributeddeeplearning_tpu.control.command import (
+        CommandResult,
+        CommandRunner,
+    )
+
+    def fake_run(self, argv, **kwargs):
+        argv = [str(a) for a in argv]
+        if "projects" in argv:
+            return CommandResult(
+                argv=argv, returncode=0,
+                stdout=json.dumps([{"projectId": "p1", "name": "P1"}]),
+            )
+        return CommandResult(argv=argv, returncode=0)
+
+    monkeypatch.setattr(CommandRunner, "run", fake_run)
+    monkeypatch.setattr("sys.stdin.isatty", lambda: True)
+    monkeypatch.setattr("builtins.input", lambda prompt="": "9")
+    assert main(["select-project"]) == 1
