@@ -64,6 +64,14 @@ def _build_bert_bench(args, devices=None):
     dtype = jnp.float32 if args.fp32 else jnp.bfloat16
 
     model_kwargs = dict(num_classes=2, dropout_rate=0.0, dtype=dtype)
+    if args.attention == "flash":
+        from distributeddeeplearning_tpu.ops.flash_attention import (
+            make_flash_attention,
+        )
+
+        model_kwargs["attention_fn"] = make_flash_attention(mesh=mesh)
+    if args.remat != "none":
+        model_kwargs["remat"] = args.remat
     if args.small:
         # tiny config for CI smoke — full bert-base takes minutes on CPU
         model_kwargs.update(
@@ -353,6 +361,12 @@ def main() -> int:
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--seq-len", type=int, default=128,
                         help="sequence length for --model bert-*")
+    parser.add_argument("--attention", default="default",
+                        choices=("default", "flash"),
+                        help="attention primitive for --model bert-*")
+    parser.add_argument("--remat", default="none",
+                        choices=("none", "full", "dots"),
+                        help="encoder-layer rematerialization for bert-*")
     parser.add_argument("--model", default="resnet50")
     parser.add_argument("--num-iters", type=int, default=5)
     parser.add_argument("--num-batches-per-iter", type=int, default=20)

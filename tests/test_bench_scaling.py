@@ -1,0 +1,49 @@
+"""The scaling-efficiency sweep (bench.py --devices) — BASELINE.json's
+second north-star metric must emit a monotone-complete table."""
+
+import io
+import json
+import sys
+import types
+from contextlib import redirect_stdout
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+def test_scaling_sweep_emits_complete_efficiency_table():
+    args = types.SimpleNamespace(
+        batch_size=8, image_size=32, seq_len=32, model="resnet18",
+        num_iters=1, num_batches_per_iter=2, num_warmup=1,
+        small=False, fp32=True, fit=False, devices="1,2,4",
+        trace_dir=None, attention="default", remat="none",
+    )
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench._run_scaling(args)
+    assert rc == 0
+    line = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert line["metric"] == "resnet18_scaling_efficiency_4chip"
+    assert line["platform"] == "cpu"  # shape check, not an ICI measurement
+    eff = line["efficiency"]
+    assert set(eff) == {"1", "2", "4"}  # complete: every requested size
+    assert eff["1"] == 1.0  # efficiency is defined against the 1-chip point
+    for v in eff.values():
+        assert 0.0 < v  # monotone-complete: all points present and positive
+    assert set(line["img_sec_total"]) == {"1", "2", "4"}
+
+
+def test_scaling_sweep_inserts_missing_one_chip_baseline():
+    args = types.SimpleNamespace(
+        batch_size=8, image_size=32, seq_len=32, model="resnet18",
+        num_iters=1, num_batches_per_iter=2, num_warmup=1,
+        small=False, fp32=True, fit=False, devices="2",
+        trace_dir=None, attention="default", remat="none",
+    )
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert bench._run_scaling(args) == 0
+    line = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert set(line["efficiency"]) == {"1", "2"}
