@@ -142,6 +142,10 @@ class TrainerConfig:
     # reference's AML run.log_row channel (imagenet_pytorch_horovod.py:424-435).
     # Local paths and gs:// both work (gs via tf.io.gfile when available).
     metrics_path: Optional[str] = None
+    # Host->device input staging depth: a background thread decodes and
+    # device_puts the next N train batches while the device executes the
+    # current one (utils/prefetch.py).  0 disables (synchronous fetch).
+    prefetch: int = 2
 
 
 @dataclasses.dataclass
@@ -198,6 +202,32 @@ class Trainer:
                         "resuming from step %d (epoch %d)", restored_step, start_epoch
                     )
 
+        owned_prefetch = None
+        if cfg.prefetch > 0:
+            from distributeddeeplearning_tpu.utils.prefetch import (
+                prefetch_to_device,
+            )
+
+            train_batches = owned_prefetch = prefetch_to_device(
+                train_batches, self.mesh, size=cfg.prefetch
+            )
+
+        try:
+            return self._fit_inner(
+                state, train_batches, eval_batches_factory, start_epoch
+            )
+        finally:
+            if owned_prefetch is not None:
+                # Stop the worker deterministically: without the close, the
+                # thread keeps decoding and device_put-ing past what fit
+                # consumed (and keeps running during error handling if the
+                # loop raised).
+                owned_prefetch.close()
+
+    def _fit_inner(
+        self, state, train_batches, eval_batches_factory, start_epoch
+    ) -> tuple:
+        cfg = self.config
         tracker = ExamplesPerSecondTracker(
             global_batch_size=cfg.global_batch_size,
             every_n_steps=cfg.log_every,

@@ -1,0 +1,71 @@
+"""Host→device input prefetch — overlap data work with device compute.
+
+The reference leans on tf.data's ``prefetch`` inside its input_fns
+(``data/tfrecords.py:166`` in the reference tree); that overlap ends at the
+host boundary.  TPU-native, the expensive hop is host→HBM: this wrapper
+stages the next ``size`` batches onto the mesh from a background thread, so
+JPEG decode / TFRecord parsing AND the H2D transfer of batch n+1 both hide
+behind the device's execution of batch n (the flax ``prefetch_to_device``
+idiom, generalized to sharded global arrays via ``shard_batch``).
+
+Usage: wraps any host-batch iterator; yields device-resident sharded
+batches.  Bounded queue (backpressure); the worker thread dies with the
+consumer (daemon + sentinel), and worker exceptions re-raise at the
+consuming ``next()`` instead of vanishing.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+from distributeddeeplearning_tpu.parallel.sharding import shard_batch
+
+_SENTINEL = object()
+
+
+class _WorkerError:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def prefetch_to_device(
+    batches: Iterator, mesh, *, size: int = 2
+) -> Iterator:
+    """Yield ``shard_batch(mesh, b)`` for each host batch ``b``, staged
+    ``size`` deep from a background thread."""
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for b in batches:
+                if stop.is_set():
+                    return
+                q.put(shard_batch(mesh, b))
+            q.put(_SENTINEL)
+        except BaseException as exc:  # noqa: BLE001 — re-raised at next()
+            q.put(_WorkerError(exc))
+
+    thread = threading.Thread(
+        target=worker, name="ddlt-prefetch", daemon=True
+    )
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                return
+            if isinstance(item, _WorkerError):
+                raise item.exc
+            yield item
+    finally:
+        stop.set()
+        # Unblock a worker stuck on a full queue, then let it notice stop.
+        try:
+            q.get_nowait()
+        except queue.Empty:
+            pass
