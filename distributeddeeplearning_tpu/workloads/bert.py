@@ -100,6 +100,7 @@ def main(
     compute_dtype: str = "bfloat16",
     distributed: Optional[bool] = None,
     # parallelism geometry (data absorbs the remainder)
+    num_slices: int = 1,  # multi-slice (DCN) data parallelism
     fsdp: int = 1,
     tensor: int = 1,
     seq: int = 1,
@@ -150,7 +151,10 @@ def main(
             f"num_experts {num_experts} not divisible by expert axis {expert}"
         )
     ctx = initialize(force=distributed)
-    mesh = create_mesh(MeshSpec(fsdp=fsdp, tensor=tensor, seq=seq, expert=expert))
+    mesh = create_mesh(
+        MeshSpec(fsdp=fsdp, tensor=tensor, seq=seq, expert=expert),
+        num_slices=num_slices,
+    )
     world = mesh.devices.size
     batch_shards = mesh.shape["data"] * mesh.shape["fsdp"]
     global_batch = batch_size * batch_shards

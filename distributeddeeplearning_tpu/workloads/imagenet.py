@@ -143,6 +143,7 @@ def main(
     profile_dir: Optional[str] = None,  # jax.profiler trace of steps 10-20
     metrics_path: Optional[str] = None,  # per-epoch JSONL rows (run.log_row)
     aux_logits: bool = False,  # InceptionV3 aux head, loss weighted 0.4
+    num_slices: int = 1,  # multi-slice (DCN) data parallelism
 ):
     """Train; returns (state, FitResult)."""
     import jax
@@ -162,7 +163,7 @@ def main(
     )
 
     ctx = initialize(force=distributed)
-    mesh = create_mesh(MeshSpec())
+    mesh = create_mesh(MeshSpec(), num_slices=num_slices)
     world = mesh.devices.size
     global_batch = batch_size * world
     per_host_batch = global_batch // ctx.process_count

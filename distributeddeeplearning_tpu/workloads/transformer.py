@@ -83,6 +83,7 @@ def main(
     data_format: str = "synthetic",  # LM data is synthetic-only (see module doc)
     # parallelism geometry: pipeline stages × data parallelism (remainder)
     pipe: int = 1,
+    num_slices: int = 1,  # multi-slice (DCN) data parallelism
     num_microbatches: int = 8,
 ):
     """Train; returns (state, FitResult)."""
@@ -121,7 +122,7 @@ def main(
             f"num_layers {num_layers} not divisible by pipe {pipe}"
         )
     ctx = initialize(force=distributed)
-    mesh = create_mesh(MeshSpec(pipe=pipe))
+    mesh = create_mesh(MeshSpec(pipe=pipe), num_slices=num_slices)
     data_shards = mesh.shape["data"] * mesh.shape["fsdp"]
     global_batch = batch_size * data_shards
     per_host_batch = global_batch // ctx.process_count

@@ -54,3 +54,72 @@ def test_two_free_axes_raise():
 def test_subset_of_devices():
     mesh = create_mesh(devices=jax.devices()[:4])
     assert world_size(mesh) == 4
+
+
+class TestMultiSlice:
+    """Multi-slice (DCN) mesh: data parallelism spans slices, everything
+    else stays on each slice's ICI (the scaling-book multi-slice recipe)."""
+
+    def test_slice_boundary_outermost_on_data(self):
+        import jax
+        import numpy as np
+
+        from distributeddeeplearning_tpu.parallel.mesh import (
+            AXIS_ORDER,
+            MeshSpec,
+            create_mesh,
+        )
+
+        mesh = create_mesh(MeshSpec(tensor=2), num_slices=2)
+        assert mesh.shape["data"] == 4 and mesh.shape["tensor"] == 2
+        devs = jax.devices()
+        arr = mesh.devices
+        data_pos = AXIS_ORDER.index("data")
+        # first half of the data axis = slice 0's devices, second = slice 1
+        first = set(
+            d.id for d in np.take(arr, range(2), axis=data_pos).ravel()
+        )
+        second = set(
+            d.id for d in np.take(arr, range(2, 4), axis=data_pos).ravel()
+        )
+        assert first == {d.id for d in devs[:4]}
+        assert second == {d.id for d in devs[4:]}
+
+    def test_training_step_runs_on_multislice_mesh(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from distributeddeeplearning_tpu.data.synthetic import synthetic_batch
+        from distributeddeeplearning_tpu.models import get_model
+        from distributeddeeplearning_tpu.parallel import (
+            MeshSpec,
+            create_mesh,
+            shard_batch,
+        )
+        from distributeddeeplearning_tpu.train.state import (
+            create_train_state,
+            sgd_momentum,
+        )
+        from distributeddeeplearning_tpu.train.step import build_train_step
+
+        mesh = create_mesh(MeshSpec(), num_slices=2)
+        model = get_model("resnet18", num_classes=5, dtype=jnp.float32)
+        tx = sgd_momentum(optax.constant_schedule(0.1))
+        state = create_train_state(jax.random.key(0), model, (8, 32, 32, 3), tx)
+        step = build_train_step(mesh, state, compute_dtype=jnp.float32)
+        batch = shard_batch(mesh, synthetic_batch(16, (32, 32, 3), 5))
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_indivisible_data_axis_rejected(self):
+        import pytest as _pytest
+
+        from distributeddeeplearning_tpu.parallel.mesh import (
+            MeshSpec,
+            create_mesh,
+        )
+
+        with _pytest.raises(ValueError, match="num_slices"):
+            create_mesh(MeshSpec(tensor=8), num_slices=2)  # data axis = 1
