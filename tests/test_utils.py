@@ -84,3 +84,24 @@ def test_examples_per_second_tracker():
     assert len(logs) == 1
     assert tr.average_examples_per_sec > 0
     assert tr.summary(total_examples=20) > 0
+
+
+def test_shipped_logging_confs_load_via_log_config(monkeypatch, tmp_path):
+    """The example INI fileConfigs ship in-package and are honored through
+    the LOG_CONFIG env contract (reference: control/src/logging.conf role)."""
+    import logging
+    from pathlib import Path
+
+    import distributeddeeplearning_tpu
+    from distributeddeeplearning_tpu.utils.logging_utils import setup_logging
+
+    conf_dir = (
+        Path(distributeddeeplearning_tpu.__file__).parent / "config" / "logging"
+    )
+    for conf in ("control.conf", "workload.conf"):
+        path = conf_dir / conf
+        assert path.exists(), path
+        monkeypatch.setenv("LOG_CONFIG", str(path))
+        logger = setup_logging()
+        assert logger.name == "ddlt"
+        assert logging.getLogger("ddlt").isEnabledFor(logging.INFO)
