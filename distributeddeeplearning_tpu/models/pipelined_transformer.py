@@ -131,6 +131,7 @@ def forward_pipelined(
     num_heads: int,
     mesh,
     num_microbatches: int,
+    remat: bool = False,
 ) -> jax.Array:
     """Same function, stages sharded over the mesh's ``pipe`` axis."""
     from distributeddeeplearning_tpu.ops.pipeline import pipeline_apply
@@ -149,7 +150,8 @@ def forward_pipelined(
 
     x = _embed(params, tokens)
     x = pipeline_apply(
-        stage_fn, staged, x, mesh=mesh, num_microbatches=num_microbatches
+        stage_fn, staged, x, mesh=mesh, num_microbatches=num_microbatches,
+        remat=remat,
     )
     return x @ params["head"]
 

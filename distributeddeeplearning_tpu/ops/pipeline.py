@@ -52,6 +52,7 @@ def pipeline_apply(
     mesh: Mesh,
     num_microbatches: int,
     axis_name: str = "pipe",
+    remat: bool = False,
 ) -> jax.Array:
     """Run ``stage_fn`` S times as a pipeline: ``y = fS(...f2(f1(x)))``.
 
@@ -61,6 +62,13 @@ def pipeline_apply(
     microbatch size divisible by the data axes.  ``stage_fn(params, mb)``
     must preserve the microbatch shape (the pipeline carries one activation
     buffer per rank).
+
+    ``remat=True`` wraps each tick's stage application in ``jax.checkpoint``:
+    the backward recomputes the stage forward from its (tiny) boundary
+    activation instead of the scan saving every tick's internals — the
+    memory role 1F1B scheduling plays in hand-scheduled pipelines, obtained
+    compiler-natively.  Activation memory drops from
+    O(ticks × stage_internals) to O(ticks × microbatch_boundary).
     """
     n_stages = int(mesh.shape[axis_name])
     leaves = jax.tree_util.tree_leaves(stage_params)
@@ -94,6 +102,8 @@ def pipeline_apply(
     )
     x_spec = P(DATA_AXES, *([None] * (x.ndim - 1)))
 
+    tick_stage_fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
     def shard_fn(params_local, x_local):
         # params_local: [1, ...] (this rank's stage); x_local: [B_local, ...]
         params_here = jax.tree_util.tree_map(lambda p: p[0], params_local)
@@ -112,7 +122,7 @@ def pipeline_apply(
                 jnp.zeros_like(state),
             )
             stage_in = jnp.where(rank == 0, inject, state)
-            y = stage_fn(params_here, stage_in)
+            y = tick_stage_fn(params_here, stage_in)
             # collect on the last rank while its outputs are valid
             slot = t - (n_stages - 1)
             valid = (rank == n_stages - 1) & (slot >= 0) & (slot < m)

@@ -85,6 +85,7 @@ def main(
     pipe: int = 1,
     num_slices: int = 1,  # multi-slice (DCN) data parallelism
     num_microbatches: int = 8,
+    remat: bool = False,  # jax.checkpoint each pipeline tick (ops/pipeline.py)
 ):
     """Train; returns (state, FitResult)."""
     import jax
@@ -164,7 +165,7 @@ def main(
         if pipe > 1:
             logits = forward_pipelined(
                 p, tokens, num_heads=num_heads, mesh=mesh,
-                num_microbatches=num_microbatches,
+                num_microbatches=num_microbatches, remat=remat,
             )
         else:
             logits = forward(p, tokens, num_heads=num_heads)
