@@ -17,12 +17,9 @@ Public surface:
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import logging
 import os
 import struct
-import subprocess
-import tempfile
 from pathlib import Path
 from typing import Iterator, Optional
 
@@ -33,45 +30,14 @@ _LIB = None
 _TRIED = False
 
 
-def _cache_dir() -> Path:
-    root = os.environ.get("DDLT_CACHE_DIR") or os.path.join(
-        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "ddlt"
-    )
-    path = Path(root)
-    path.mkdir(parents=True, exist_ok=True)
-    return path
-
-
-def _compile() -> Optional[Path]:
-    if not _SRC.exists():
-        return None
-    src = _SRC.read_bytes()
-    tag = hashlib.sha256(src).hexdigest()[:16]
-    out = _cache_dir() / f"ddlt_records-{tag}.so"
-    if out.exists():
-        return out
-    for cc in ("cc", "gcc", "clang"):
-        try:
-            with tempfile.TemporaryDirectory() as td:
-                tmp = Path(td) / out.name
-                subprocess.run(
-                    [cc, "-O2", "-shared", "-fPIC", str(_SRC), "-o", str(tmp)],
-                    check=True,
-                    capture_output=True,
-                )
-                tmp.replace(out)
-            return out
-        except (OSError, subprocess.CalledProcessError) as e:
-            logger.debug("native build with %s failed: %s", cc, e)
-    return None
-
-
 def _load() -> Optional[ctypes.CDLL]:
     global _LIB, _TRIED
     if _TRIED:
         return _LIB
     _TRIED = True
-    path = _compile()
+    from distributeddeeplearning_tpu.data._native_build import compile_cached
+
+    path = compile_cached(_SRC, "ddlt_records")
     if path is None:
         logger.info("native record reader unavailable; using Python fallback")
         return None

@@ -1,0 +1,76 @@
+"""Shared compile-on-demand machinery for the in-repo C components.
+
+One scheme serves the TFRecord reader (``_native.py``) and the JPEG
+decoder (``_native_image.py``): the C source compiles once with the system
+compiler into a per-user cache keyed by a source hash (edits rebuild
+automatically), no build-system dependency, zero-egress friendly.  Every
+failure mode — no compiler, missing link library, unwritable cache dir,
+cross-filesystem tmp — returns None so callers keep their pure-Python /
+PIL fallbacks; nothing here raises into the data pipeline.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional, Sequence
+
+logger = logging.getLogger("ddlt.data.native")
+
+
+def cache_dir() -> Optional[Path]:
+    root = os.environ.get("DDLT_CACHE_DIR") or os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "ddlt"
+    )
+    path = Path(root)
+    try:
+        path.mkdir(parents=True, exist_ok=True)
+    except OSError as exc:  # read-only HOME on a worker: fall back quietly
+        logger.info("native cache dir %s unavailable (%s)", path, exc)
+        return None
+    return path
+
+
+def compile_cached(
+    src_path: Path, libname: str, extra_args: Sequence[str] = ()
+) -> Optional[Path]:
+    """Compile ``src_path`` into the cache as ``<libname>-<hash>.so``.
+
+    Returns the shared-library path, or None when anything prevents it.
+    """
+    if not src_path.exists():
+        return None
+    cache = cache_dir()
+    if cache is None:
+        return None
+    src = src_path.read_bytes()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = cache / f"{libname}-{tag}.so"
+    if out.exists():
+        return out
+    # Build inside the cache dir (not a TemporaryDirectory): os.replace
+    # must stay on one filesystem — /tmp is commonly tmpfs while ~/.cache
+    # is not, and a cross-device replace raises EXDEV.
+    tmp = out.with_suffix(f".so.tmp{os.getpid()}")
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", str(src_path), "-o", str(tmp),
+                 *extra_args],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, out)
+            return out
+        except (subprocess.CalledProcessError, FileNotFoundError, OSError) as exc:
+            logger.debug("compile with %s failed: %s", cc, exc)
+        finally:
+            try:
+                if tmp.exists():
+                    tmp.unlink()
+            except OSError:
+                pass
+    return None

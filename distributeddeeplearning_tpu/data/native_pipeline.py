@@ -5,8 +5,11 @@ framework's own C record reader (``data/csrc/ddlt_records.c`` via
 ``data/_native.py``) streams and CRC-verifies the frames, the minimal
 wire-format walker extracts ``image/encoded``/``image/class/label`` (same
 schema as the reference converter, ``convert_imagenet_to_tf_records.py:111-146``),
-PIL decodes JPEGs on a thread pool, and numpy applies the reference
-preprocessing recipe (``imagenet_preprocessing.py:180-222``):
+the in-repo C decoder (``data/csrc/ddlt_image.c`` — libjpeg +
+Pillow-equivalent bilinear resample) decodes JPEGs on a thread pool with
+PIL covering what it declines (CMYK scans, corrupt streams, no compiler),
+and numpy applies the reference preprocessing recipe
+(``imagenet_preprocessing.py:180-222``):
 
 - train: decode → plain bilinear resize (squash, no crop/flip);
 - eval: aspect-preserving central crop (224/256 of the short side) →
@@ -42,7 +45,16 @@ from distributeddeeplearning_tpu.data.tfrecords import shard_filenames
 
 
 def _decode_train(jpeg: bytes, image_size: int) -> np.ndarray:
-    """Reference train path: decode + bilinear squash-resize."""
+    """Reference train path: decode + bilinear squash-resize.
+
+    Hot path is the in-repo C decoder (libjpeg + Pillow-equivalent
+    triangle-filter resample, ``csrc/ddlt_image.c``); PIL covers what it
+    declines (CMYK scans, corrupt streams, no compiler)."""
+    from distributeddeeplearning_tpu.data._native_image import decode_resize
+
+    out = decode_resize(jpeg, image_size)
+    if out is not None:
+        return out
     from PIL import Image
     import io
 
@@ -54,6 +66,11 @@ def _decode_train(jpeg: bytes, image_size: int) -> np.ndarray:
 def _decode_eval(jpeg: bytes, image_size: int) -> np.ndarray:
     """Eval path: central crop of image_size/RESIZE_MIN of the short side,
     then bilinear resize — ``decode_and_center_crop`` parity."""
+    from distributeddeeplearning_tpu.data._native_image import decode_resize
+
+    out = decode_resize(jpeg, image_size, crop_frac=image_size / RESIZE_MIN)
+    if out is not None:
+        return out
     from PIL import Image
     import io
 

@@ -1,0 +1,82 @@
+"""Native JPEG decoder (data/csrc/ddlt_image.c via data/_native_image.py):
+Pillow-parity resampling, colorspace handling, fallback contract."""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from distributeddeeplearning_tpu.data._native_image import (
+    decode_resize,
+    native_available,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C compiler / libjpeg in this env"
+)
+
+
+def _jpeg(h=371, w=523, quality=95, mode="RGB"):
+    yy, xx = np.mgrid[0:h, 0:w]
+    img = np.stack(
+        [(xx * 255 / w), (yy * 255 / h), ((xx + yy) * 255 / (w + h))], -1
+    ).astype(np.uint8)
+    if mode == "L":
+        pil = Image.fromarray(img[:, :, 0], "L")
+    else:
+        pil = Image.fromarray(img)
+    buf = io.BytesIO()
+    pil.save(buf, "JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def _pil_reference(jpeg, size, crop_frac=0.0):
+    img = Image.open(io.BytesIO(jpeg)).convert("RGB")
+    if crop_frac:
+        w, h = img.size
+        crop = int(min(h, w) * crop_frac)
+        x, y = (w - crop) // 2, (h - crop) // 2
+        img = img.crop((x, y, x + crop, y + crop))
+    return np.asarray(img.resize((size, size), Image.BILINEAR), np.float32)
+
+
+@pytest.mark.parametrize("size,crop", [(224, 0.0), (224, 224 / 256), (64, 0.0)])
+def test_matches_pillow_bilinear(size, crop):
+    jpeg = _jpeg()
+    got = decode_resize(jpeg, size, crop)
+    assert got is not None and got.shape == (size, size, 3)
+    want = _pil_reference(jpeg, size, crop)
+    # PIL uses 8-bit fixed-point filter weights; the C path is float —
+    # agreement within one count per channel.
+    np.testing.assert_allclose(got, want, atol=1.5)
+
+
+def test_grayscale_jpeg_expands_to_rgb():
+    got = decode_resize(_jpeg(mode="L"), 64)
+    assert got is not None and got.shape == (64, 64, 3)
+    np.testing.assert_allclose(got[..., 0], got[..., 1], atol=1e-3)
+
+
+def test_corrupt_stream_returns_none_for_fallback():
+    assert decode_resize(b"definitely not a jpeg", 64) is None
+
+
+def test_pipeline_decoders_agree_with_pil_paths():
+    """_decode_train/_decode_eval (whichever path they take) stay within
+    fixed-point tolerance of the PIL reference implementation."""
+    from distributeddeeplearning_tpu.data.native_pipeline import (
+        RESIZE_MIN,
+        _decode_eval,
+        _decode_train,
+    )
+
+    jpeg = _jpeg()
+    np.testing.assert_allclose(
+        _decode_train(jpeg, 128), _pil_reference(jpeg, 128), atol=1.5
+    )
+    np.testing.assert_allclose(
+        _decode_eval(jpeg, 128),
+        _pil_reference(jpeg, 128, 128 / RESIZE_MIN),
+        atol=1.5,
+    )
