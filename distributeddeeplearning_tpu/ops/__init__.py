@@ -22,11 +22,17 @@ from distributeddeeplearning_tpu.ops.ring_attention import (
     make_ring_attention,
     ring_attention,
 )
+from distributeddeeplearning_tpu.ops.ulysses_attention import (
+    make_ulysses_attention,
+    ulysses_attention,
+)
 
 __all__ = [
     "flash_attention",
     "make_flash_attention",
     "make_ring_attention",
+    "make_ulysses_attention",
     "pipeline_apply",
     "ring_attention",
+    "ulysses_attention",
 ]

@@ -1,0 +1,111 @@
+"""Ulysses-style sequence parallelism — all-to-all attention over ``seq``.
+
+The second of the two standard sequence-parallel schemes (DeepSpeed-Ulysses,
+Jacobs et al. 2309.14509; :mod:`ops.ring_attention` is the other):
+
+- activations arrive sequence-sharded ``[B, S/n, H, D]`` like every other
+  sequence-parallel layer;
+- an **all-to-all** re-shards tokens→heads: each device receives the FULL
+  sequence for ``H/n`` of the heads;
+- plain (or flash) attention runs locally — heads are independent, so no
+  further communication inside the primitive;
+- a second all-to-all restores sequence sharding for the MLP that follows.
+
+Trade-off vs the ring: two all-to-alls of the qkv/context tensors per layer
+instead of ``n`` ppermutes of k/v — cheaper when heads are plentiful and
+sequences moderate; the ring wins at extreme sequence lengths where holding
+S full-length head-slices exceeds memory.  Requires ``H % n == 0`` (the
+ring has no such constraint).  XLA compiles the all-to-all onto ICI.
+
+``make_ulysses_attention(mesh)`` returns an ``attention_fn`` drop-in for
+``models.bert.BertEncoder`` — select with ``--attention ulysses`` on the
+bert workload.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
+
+
+def _ulysses_body(q, k, v, mask, *, axis_name: str, n: int, dtype):
+    """Runs inside shard_map: q/k/v ``[B, S/n, H, D]`` locally."""
+    from distributeddeeplearning_tpu.models.bert import dot_product_attention
+
+    # tokens -> heads: [B, S/n, H, D] -> [B, S, H/n, D].
+    # all_to_all splits the head axis n ways and concatenates the gathered
+    # chunks along the sequence axis.
+    def to_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def to_tokens(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    # The key-padding mask is per-token: gather the full sequence's mask
+    # (bool bits — cheap) so local attention sees all S key positions.
+    mask_full = jax.lax.all_gather(mask, axis_name, axis=3, tiled=True)
+    ctx = dot_product_attention(qh, kh, vh, mask_full, dtype=dtype)
+    return to_tokens(ctx)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array],
+    *,
+    mesh: Mesh,
+    dtype: jnp.dtype,
+    axis_name: str = "seq",
+):
+    """All-to-all sequence-parallel attention; drop-in for
+    :func:`models.bert.dot_product_attention` ([B, S, H, D] global)."""
+    from distributeddeeplearning_tpu.parallel.compat import shard_map
+
+    n = int(mesh.shape[axis_name])
+    if n == 1:
+        from distributeddeeplearning_tpu.models.bert import dot_product_attention
+
+        return dot_product_attention(q, k, v, mask, dtype=dtype)
+    heads = q.shape[2]
+    if heads % n:
+        raise ValueError(
+            f"ulysses attention needs heads ({heads}) divisible by the seq "
+            f"axis ({n}); use ring attention for head-scarce models"
+        )
+    if mask is None:
+        mask = jnp.ones((q.shape[0], 1, 1, q.shape[1]), bool)
+    else:
+        mask = jnp.broadcast_to(mask, (q.shape[0], 1, 1, q.shape[1]))
+
+    qkv_spec = P(DATA_AXES, axis_name, None, None)
+    mask_spec = P(DATA_AXES, None, None, axis_name)
+    body = partial(_ulysses_body, axis_name=axis_name, n=n, dtype=dtype)
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+    )(q, k, v, mask)
+
+
+def make_ulysses_attention(mesh: Mesh, axis_name: str = "seq"):
+    """Bind a mesh → an ``attention_fn`` for the transformer models."""
+
+    def attention_fn(q, k, v, mask, *, dtype):
+        return ulysses_attention(
+            q, k, v, mask, mesh=mesh, dtype=dtype, axis_name=axis_name
+        )
+
+    return attention_fn

@@ -105,7 +105,7 @@ def main(
     tensor: int = 1,
     seq: int = 1,
     expert: int = 1,
-    attention: str = "auto",  # auto|default|flash|ring
+    attention: str = "auto",  # auto|default|flash|ring|ulysses
     remat: str = "none",  # none|full|dots — encoder-layer rematerialization
     num_experts: int = 0,  # >0 = MoE FFN in every 2nd layer (models/moe.py)
     # model-size overrides (tiny configs for tests/smoke)
@@ -191,15 +191,24 @@ def main(
     ):
         if value is not None:
             model_kwargs[key] = value
-    # Attention primitive selection: seq>1 requires the ring (the tokens are
-    # sharded over the seq axis); otherwise "flash" injects the Pallas
-    # blocked kernel (ops/flash_attention.py), "default" the fused XLA path.
+    # Attention primitive selection: seq>1 needs a sequence-parallel
+    # primitive — "ring" (ppermute rotation, any head count) or "ulysses"
+    # (all-to-all head re-sharding, heads % seq == 0); otherwise "flash"
+    # injects the Pallas blocked kernel (ops/flash_attention.py), "default"
+    # the fused XLA path.
     if attention == "auto":
         attention = "ring" if seq > 1 else "default"
-    if seq > 1 and attention != "ring":
-        raise ValueError(f"seq={seq} requires attention='ring', got {attention!r}")
+    if seq > 1 and attention not in ("ring", "ulysses"):
+        raise ValueError(
+            f"seq={seq} requires attention='ring' or 'ulysses', got "
+            f"{attention!r}"
+        )
     if attention == "ring":
         model_kwargs["attention_fn"] = make_ring_attention(mesh)
+    elif attention == "ulysses":
+        from distributeddeeplearning_tpu.ops import make_ulysses_attention
+
+        model_kwargs["attention_fn"] = make_ulysses_attention(mesh)
     elif attention == "flash":
         from distributeddeeplearning_tpu.ops.flash_attention import (
             make_flash_attention,
