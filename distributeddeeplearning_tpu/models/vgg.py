@@ -1,0 +1,110 @@
+"""VGG and AlexNet — the rest of the tf_cnn_benchmarks model menu.
+
+The reference's benchmark role is played by tf_cnn_benchmarks, whose model
+flag covers the classic CNN families beyond ResNet/Inception (``--model
+vgg16|alexnet|…``, cloned at ``TensorFlow_benchmark/tensorflow_benchmark.py:16-28``).
+These are the TPU-native counterparts: NHWC, bf16 activations / fp32
+params, registered in the same model registry so ``bench.py --model vgg16``
+and the imagenet workload's ``--model`` flag accept them.
+
+Architectures follow the original papers (Simonyan & Zisserman 1409.1556
+configs A/D; Krizhevsky 2012 as the one-tower variant tf_cnn_benchmarks
+uses) with BatchNorm intentionally absent, as in the originals — dropout
+regularizes the classifier head instead.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.models import register
+
+# config -> conv widths per block ("M" = maxpool); 1409.1556 Table 1
+VGG_CONFIGS = {
+    11: (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"),
+    19: (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    depth: int = 16
+    num_classes: int = 1001
+    dtype: jnp.dtype = jnp.bfloat16
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        conv_i = 0
+        for item in VGG_CONFIGS[self.depth]:
+            if item == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+                continue
+            conv_i += 1
+            x = nn.Conv(
+                item, (3, 3), padding="SAME", dtype=self.dtype,
+                param_dtype=jnp.float32, name=f"conv{conv_i}",
+            )(x)
+            x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        for i, width in enumerate((4096, 4096)):
+            x = nn.relu(nn.Dense(
+                width, dtype=self.dtype, param_dtype=jnp.float32,
+                name=f"fc{i + 1}",
+            )(x))
+            if self.dropout_rate:
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=jnp.float32,
+            name="head",
+        )(x)
+        return x.astype(jnp.float32)
+
+
+class AlexNet(nn.Module):
+    """One-tower AlexNet (the tf_cnn_benchmarks variant)."""
+
+    num_classes: int = 1001
+    dtype: jnp.dtype = jnp.bfloat16
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        conv = partial(
+            nn.Conv, dtype=self.dtype, param_dtype=jnp.float32
+        )
+        x = nn.relu(conv(64, (11, 11), strides=(4, 4), padding="SAME",
+                         name="conv1")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(192, (5, 5), padding="SAME", name="conv2")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(384, (3, 3), padding="SAME", name="conv3")(x))
+        x = nn.relu(conv(256, (3, 3), padding="SAME", name="conv4")(x))
+        x = nn.relu(conv(256, (3, 3), padding="SAME", name="conv5")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        for i in (1, 2):
+            x = nn.relu(nn.Dense(
+                4096, dtype=self.dtype, param_dtype=jnp.float32,
+                name=f"fc{i}",
+            )(x))
+            if self.dropout_rate:
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=jnp.float32,
+            name="head",
+        )(x)
+        return x.astype(jnp.float32)
+
+
+for _depth in VGG_CONFIGS:
+    register(f"vgg{_depth}")(partial(VGG, depth=_depth))
+register("alexnet")(AlexNet)
