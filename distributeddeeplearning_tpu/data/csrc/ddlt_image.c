@@ -31,8 +31,10 @@ static void ddlt_error_exit(j_common_ptr cinfo) {
 }
 
 static void ddlt_emit_message(j_common_ptr cinfo, int msg_level) {
-  (void)cinfo;
-  (void)msg_level; /* swallow warnings; corrupt data fails via error_exit */
+  /* Print nothing, but keep the warning COUNT — the decode path checks
+   * num_warnings to reject gray-filled truncated streams (the default
+   * handler increments it; a plain no-op would silence that signal). */
+  if (msg_level < 0) cinfo->err->num_warnings++;
 }
 
 /* Decode a JPEG byte stream to tightly-packed RGB8.  The caller owns *out
@@ -90,7 +92,16 @@ int ddlt_jpeg_decode(const unsigned char *buf, unsigned long len,
     jpeg_read_scanlines(&cinfo, rows, 1);
   }
   jpeg_finish_decompress(&cinfo);
+  /* emit_message is a no-op, but libjpeg still counts warnings (premature
+   * EOF, corrupt scan data).  A "successful" decode that needed warnings
+   * is gray-filled garbage — report failure so the PIL path (which raises
+   * on truncation) keeps the loud-corruption contract. */
+  long warnings = cinfo.err->num_warnings;
   jpeg_destroy_decompress(&cinfo);
+  if (warnings > 0) {
+    free(pixels);
+    return 6;
+  }
   *out = pixels;
   *width = w;
   *height = h;

@@ -80,3 +80,10 @@ def test_pipeline_decoders_agree_with_pil_paths():
         _pil_reference(jpeg, 128, 128 / RESIZE_MIN),
         atol=1.5,
     )
+
+
+def test_truncated_stream_returns_none_for_fallback():
+    """Premature-EOF JPEGs decode as gray-filled garbage in raw libjpeg;
+    the wrapper must report failure so PIL's loud-truncation path decides."""
+    jpeg = _jpeg()
+    assert decode_resize(jpeg[: len(jpeg) // 2], 64) is None
