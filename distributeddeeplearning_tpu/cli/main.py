@@ -158,6 +158,28 @@ def build_parser() -> argparse.ArgumentParser:
     tpu_sub.add_parser("delete", help="Delete the pod")
     tpu_sub.add_parser("status", help="Describe the pod")
     tpu_sub.add_parser("list", help="List pods in the zone")
+    q_p = tpu_sub.add_parser(
+        "queue", help="File a queued-resource request for the pod "
+        "(how v5e+ capacity is obtained in practice)"
+    )
+    q_p.add_argument("--request-id", default=None)
+    q_kind = q_p.add_mutually_exclusive_group()
+    q_kind.add_argument("--spot", action="store_true")
+    q_kind.add_argument("--reserved", action="store_true")
+    q_p.add_argument("--valid-until", default=None,
+                     help="e.g. 6h — auto-expire an unfulfilled request")
+    qs_p = tpu_sub.add_parser(
+        "queue-status", help="Queued-resource request state"
+    )
+    qs_p.add_argument("--request-id", default=None)
+    qd_p = tpu_sub.add_parser(
+        "queue-delete", help="Cancel/release the queued-resource request"
+    )
+    qd_p.add_argument("--request-id", default=None)
+    qd_p.add_argument(
+        "--force", action="store_true",
+        help="Required when the request is ACTIVE (tears down its live node)",
+    )
     ssh_p = tpu_sub.add_parser("ssh", help="Run a command on pod workers")
     ssh_p.add_argument("--worker", default="all")
     ssh_p.add_argument("cmd", help="Shell command to run")
@@ -583,6 +605,29 @@ def _cmd_tpu(args) -> int:
         pod.ssh(args.cmd, worker=args.worker)
     elif args.tpu_command == "bootstrap":
         Submitter(cfg, runner, registry).bootstrap_pod(args.project_dir, pod=pod)
+    elif args.tpu_command == "queue":
+        rid = pod.request_queued(
+            request_id=args.request_id,
+            spot=args.spot,
+            reserved=args.reserved,
+            valid_until_duration=args.valid_until,
+        )
+        print(f"queued-resource request {rid} filed for TPU {pod.name}")
+    elif args.tpu_command == "queue-status":
+        state = pod.queued_state(args.request_id)
+        if state is None:
+            print("no queued-resource request found")
+            return 1
+        print(state)
+    elif args.tpu_command == "queue-delete":
+        if pod.delete_queued(args.request_id, force=args.force):
+            print("queued-resource request delete requested")
+        else:
+            print(
+                "request is ACTIVE (owns a live node); re-run with --force",
+                file=sys.stderr,
+            )
+            return 1
     return 0
 
 

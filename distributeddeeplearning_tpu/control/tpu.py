@@ -77,16 +77,16 @@ class TpuPod:
 
     # -- composed gcloud invocations ------------------------------------
 
-    def _base(self, *verbs: str) -> List[str]:
-        argv = ["gcloud", "compute", "tpus", "tpu-vm", *verbs]
+    def _base(self, *verbs: str, surface: str = "tpu-vm") -> List[str]:
+        argv = ["gcloud", "compute", "tpus", surface, *verbs]
         if self.project:
             argv += ["--project", self.project]
         return argv
 
-    def describe(self):
-        """Pod metadata dict, or None when the pod does not exist."""
+    def _describe_json(self, name: str, *, surface: str = "tpu-vm"):
+        """Describe ``name`` on a gcloud surface → dict, or None if absent."""
         result = self.runner.run(
-            self._base("describe", self.name)
+            self._base("describe", name, surface=surface)
             + ["--zone", self.zone, "--format", "json"],
             check=False,
         )
@@ -100,6 +100,10 @@ class TpuPod:
         except json.JSONDecodeError:
             return {}
 
+    def describe(self):
+        """Pod metadata dict, or None when the pod does not exist."""
+        return self._describe_json(self.name)
+
     def exists(self) -> bool:
         return self.describe() is not None
 
@@ -112,8 +116,20 @@ class TpuPod:
         return meta.get("state", "UNKNOWN")
 
     def recreate(self) -> None:
-        """Delete + create — the preemption-recovery primitive."""
+        """Delete + re-provision — the preemption-recovery primitive.
+
+        Queued-resource-managed pods (a request exists for this pod's
+        default request id) cannot be removed with ``tpu-vm delete``; they
+        are torn down via the request and RE-QUEUED.  The new request may
+        sit in WAITING_FOR_RESOURCES — callers that need the pod
+        synchronously (the preemption retry loop) will then see a
+        non-READY state and stop cleanly rather than loop on a dead node.
+        """
         logger.warning("recreating TPU %s", self.name)
+        if self.queued_state() is not None:
+            self.delete_queued(force=True)
+            self.request_queued()
+            return
         self.delete()
         self.create()
 
@@ -144,6 +160,81 @@ class TpuPod:
             self._base("delete", self.name) + ["--zone", self.zone, "--quiet"],
             check=False,
         )
+
+    # -- queued resources (how v5e+ capacity is actually obtained) ------
+
+    def request_queued(
+        self,
+        *,
+        request_id: Optional[str] = None,
+        spot: bool = False,
+        reserved: bool = False,
+        valid_until_duration: Optional[str] = None,
+    ) -> str:
+        """File a queued-resource request for this pod.
+
+        On-demand `create` frequently stockouts for v5e/v5p slices; the
+        queued-resources API is how capacity is obtained in practice (the
+        role AML's autoscale quota played, ``aml_compute.py:47-71``).  The
+        request provisions a node with this pod's name when granted, so
+        every other verb (ssh/scp/bootstrap/submit) works unchanged once
+        ``queued_state`` reports ACTIVE.  Returns the request id.
+        """
+        rid = request_id or f"{self.name}-req"
+        argv = self._base("create", rid, surface="queued-resources") + [
+            "--zone", self.zone,
+            "--node-id", self.name,
+            "--accelerator-type", self.accelerator_type,
+            "--runtime-version", self.runtime_version,
+        ]
+        if spot or self.preemptible:
+            # TPU_PREEMPTIBLE=true means spot semantics everywhere —
+            # create() adds --preemptible; the queued surface calls it spot.
+            argv.append("--spot")
+        if reserved:
+            argv.append("--reserved")
+        if valid_until_duration:
+            argv += ["--valid-until-duration", valid_until_duration]
+        self.runner.run(argv)
+        return rid
+
+    def queued_state(self, request_id: Optional[str] = None) -> Optional[str]:
+        """The request's lifecycle state (WAITING_FOR_RESOURCES,
+        PROVISIONING, ACTIVE, FAILED, SUSPENDED, …); None when absent."""
+        rid = request_id or f"{self.name}-req"
+        meta = self._describe_json(rid, surface="queued-resources")
+        if meta is None or not meta:
+            # absent OR an empty describe payload: no usable request —
+            # treat like absence so tpu-vm-managed pods aren't misclassified
+            return None
+        state = meta.get("state")
+        if isinstance(state, dict):
+            return state.get("state", "UNKNOWN")
+        return str(state) if state else "UNKNOWN"
+
+    def delete_queued(
+        self, request_id: Optional[str] = None, *, force: bool = False
+    ) -> bool:
+        """Cancel/release the request (also required before re-requesting a
+        failed one — the API keeps terminal requests around).
+
+        An ACTIVE request owns a LIVE TPU node; deleting it tears the node
+        (and any running job) down, so that path requires ``force=True``.
+        Returns False when refused.
+        """
+        rid = request_id or f"{self.name}-req"
+        if not force and self.queued_state(rid) == "ACTIVE":
+            logger.error(
+                "queued-resource request %s is ACTIVE (owns a live TPU "
+                "node); pass force to tear it down", rid,
+            )
+            return False
+        self.runner.run(
+            self._base("delete", rid, surface="queued-resources")
+            + ["--zone", self.zone, "--quiet", "--force"],
+            check=False,
+        )
+        return True
 
     def ssh(
         self,

@@ -269,12 +269,18 @@ class TestSubmitter:
             counters["ssh"] += 1
             return counters["ssh"] <= fail_ssh_times
 
+        def describe_queued(argv):
+            # No queued-resource request exists for these on-demand pods —
+            # a real gcloud describe of an absent request exits nonzero.
+            return "queued-resources" in argv and "describe" in argv
+
         def describe(argv):
-            return "describe" in argv
+            return "tpu-vm" in argv and "describe" in argv
 
         return FakeRunner(
             [
                 (ssh_fails, CommandResult([], returncode=255)),
+                (describe_queued, CommandResult([], returncode=1)),
                 (
                     describe,
                     CommandResult(
@@ -659,3 +665,122 @@ class TestStreamingAndPoll:
         run = self._stranded_run(cfg, registry)
         polled = Submitter(cfg, runner, registry).poll_run("exp1", run.run_id)
         assert polled.status == "running"
+
+
+class TestQueuedResources:
+    """Queued-resource provisioning — how v5e+ capacity is obtained when
+    on-demand create stockouts (the AML autoscale-quota role)."""
+
+    def test_request_composes_gcloud_queued_create(self):
+        runner = FakeRunner()
+        pod = make_pod(runner)
+        rid = pod.request_queued(spot=True, valid_until_duration="6h")
+        assert rid == "test-pod-req"
+        argv = runner.history[-1]
+        assert argv[:5] == [
+            "gcloud", "compute", "tpus", "queued-resources", "create"
+        ]
+        assert "test-pod-req" in argv
+        assert argv[argv.index("--node-id") + 1] == "test-pod"
+        assert argv[argv.index("--accelerator-type") + 1] == "v5litepod-32"
+        assert "--spot" in argv
+        assert argv[argv.index("--valid-until-duration") + 1] == "6h"
+
+    def test_queued_state_parses_nested_state(self):
+        def describe(argv):
+            return "queued-resources" in argv and "describe" in argv
+
+        runner = FakeRunner(
+            [
+                (
+                    describe,
+                    CommandResult(
+                        [], returncode=0,
+                        stdout='{"state": {"state": "WAITING_FOR_RESOURCES"}}',
+                    ),
+                )
+            ]
+        )
+        pod = make_pod(runner)
+        assert pod.queued_state() == "WAITING_FOR_RESOURCES"
+
+    def test_queued_state_absent(self):
+        def describe(argv):
+            return "queued-resources" in argv and "describe" in argv
+
+        runner = FakeRunner([(describe, CommandResult([], returncode=1))])
+        assert make_pod(runner).queued_state() is None
+
+    def test_delete_queued_forces(self):
+        runner = FakeRunner()
+        make_pod(runner).delete_queued("custom-req")
+        argv = runner.history[-1]
+        assert "delete" in argv and "custom-req" in argv and "--force" in argv
+
+    def test_cli_queue_verbs(self, tmp_path, monkeypatch, capsys):
+        monkeypatch.chdir(tmp_path)
+        (tmp_path / ".env").write_text(
+            "TPU_NAME=pod-q\nTPU_TYPE=v5litepod-16\nGCP_ZONE=us-west4-a\n"
+        )
+        from distributeddeeplearning_tpu.cli.main import main
+
+        rc = main(["--dry-run", "tpu", "queue", "--spot"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "queued-resources create pod-q-req" in out
+        assert "--node-id pod-q" in out
+
+    def test_delete_queued_refuses_active_without_force(self):
+        def describe(argv):
+            return "queued-resources" in argv and "describe" in argv
+
+        runner = FakeRunner(
+            [
+                (
+                    describe,
+                    CommandResult(
+                        [], returncode=0,
+                        stdout='{"state": {"state": "ACTIVE"}}',
+                    ),
+                )
+            ]
+        )
+        pod = make_pod(runner)
+        assert pod.delete_queued() is False
+        assert not any("delete" in a for a in runner.history)
+        assert pod.delete_queued(force=True) is True
+        assert any("delete" in a for a in runner.history)
+
+    def test_preemptible_pod_requests_spot_capacity(self):
+        runner = FakeRunner()
+        pod = make_pod(runner, preemptible=True)
+        pod.request_queued()
+        assert "--spot" in runner.history[-1]
+
+    def test_recreate_requeues_queued_managed_pod(self):
+        """Preemption recovery for a queued-provisioned pod must go through
+        the queued-resources surface (tpu-vm delete cannot remove it)."""
+        def describe_q(argv):
+            return "queued-resources" in argv and "describe" in argv
+
+        runner = FakeRunner(
+            [
+                (
+                    describe_q,
+                    CommandResult(
+                        [], returncode=0,
+                        stdout='{"state": {"state": "SUSPENDED"}}',
+                    ),
+                )
+            ]
+        )
+        pod = make_pod(runner)
+        pod.recreate()
+        surfaces = [
+            (a[3], a[4]) for a in runner.history if len(a) > 4 and a[0] == "gcloud"
+        ]
+        assert ("queued-resources", "delete") in surfaces
+        assert ("queued-resources", "create") in surfaces
+        # and no tpu-vm create/delete happened
+        assert ("tpu-vm", "create") not in surfaces
+        assert ("tpu-vm", "delete") not in surfaces
