@@ -30,7 +30,6 @@ import numpy as np
 from distributeddeeplearning_tpu.parallel.distributed import is_primary
 from distributeddeeplearning_tpu.parallel.sharding import shard_batch
 from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
-from distributeddeeplearning_tpu.utils.metrics import AverageMeter
 from distributeddeeplearning_tpu.utils.throughput import ExamplesPerSecondTracker
 
 logger = logging.getLogger("ddlt.train")
@@ -357,7 +356,6 @@ class Trainer:
         many steps with no further host round-trips.  Batches are weighted by
         size so ragged final batches do not bias top-1.
         """
-        meters: Dict[str, AverageMeter] = {}
         multi_host = jax.process_count() > 1
         limit = self.config.eval_steps
         if multi_host:
@@ -380,6 +378,13 @@ class Trainer:
             limit = common
         else:
             batches = eval_batches
+        # Size-weighted sums accumulate ON DEVICE (batch sizes are known on
+        # the host, so the weights add no sync); the only host fetch is the
+        # final per-metric float.  A per-batch float(v) here serialized
+        # dispatch — ~100 ms/batch on tunneled backends — the same bug the
+        # train loop's on-device accumulator fixed (r02).
+        sums: Dict[str, jax.Array] = {}
+        total_weight = 0
         steps = 0
         while True:
             if limit is not None and steps >= limit:
@@ -390,6 +395,13 @@ class Trainer:
             batch_size = len(next(iter(batch.values())))
             metrics = self.eval_step(state, shard_batch(self.mesh, batch))
             for k, v in metrics.items():
-                meters.setdefault(k, AverageMeter(k)).update(float(v), batch_size)
+                weighted = v * batch_size
+                sums[k] = weighted if k not in sums else sums[k] + weighted
+            total_weight += batch_size
             steps += 1
-        return {k: m.avg for k, m in meters.items()}
+        if not sums or total_weight == 0:
+            # zero batches OR only zero-length batches (empty host shards):
+            # the old AverageMeter.avg returned 0.0 here; an empty dict is
+            # the cleaner "no eval happened" signal callers already handle
+            return {}
+        return {k: float(v) / total_weight for k, v in sums.items()}
