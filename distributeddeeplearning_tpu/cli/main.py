@@ -252,6 +252,11 @@ def build_parser() -> argparse.ArgumentParser:
     runs_p.add_argument("--experiment", default=None)
     runs_p.add_argument("--last", type=int, default=10)
     runs_p.add_argument(
+        "--status", default=None,
+        choices=("queued", "running", "completed", "failed"),
+        help="Only show runs in this state (e.g. --status running)",
+    )
+    runs_p.add_argument(
         "--run", default=None,
         help="Show one run: status + log tail + per-epoch metric rows",
     )
@@ -479,7 +484,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print("--- metrics ---")
                 print(content.rstrip())
             return 0
-        print(registry.format_runs(experiment, args.last))
+        print(
+            registry.format_runs(
+                experiment, args.last, status=getattr(args, "status", None)
+            )
+        )
         return 0
     if args.command == "experiments":
         _, _, registry = _control(args)

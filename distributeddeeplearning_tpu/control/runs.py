@@ -124,7 +124,9 @@ class RunRegistry:
             return []
         return sorted(d.name for d in self.root.iterdir() if d.is_dir())
 
-    def runs(self, experiment: str, last: int = 10) -> List[Run]:
+    def runs(
+        self, experiment: str, last: int = 10, status: Optional[str] = None
+    ) -> List[Run]:
         exp_dir = self.root / experiment
         if not exp_dir.exists():
             return []
@@ -133,15 +135,23 @@ class RunRegistry:
             run = self._load(run_dir / RUN_FILE)
             if run is None:
                 continue
+            if status is not None and run.status != status:
+                continue
             loaded.append(run)
             if len(loaded) >= last:
                 break
         return loaded
 
-    def format_runs(self, experiment: str, last: int = 10) -> str:
-        """Tabulated listing (``az ml run list -o table`` role)."""
-        rows = self.runs(experiment, last)
+    def format_runs(
+        self, experiment: str, last: int = 10, status: Optional[str] = None
+    ) -> str:
+        """Tabulated listing (``az ml run list -o table`` role); ``status``
+        filters — ``status="running"`` is the live view (``_select_runs``
+        Running-filter role, ``aml_compute.py:603-617``)."""
+        rows = self.runs(experiment, last, status=status)
         if not rows:
+            if status is not None:
+                return f"no {status} runs for experiment {experiment!r}"
             return f"no runs for experiment {experiment!r}"
         header = f"{'RUN_ID':<22}{'WORKLOAD':<14}{'MODE':<8}{'STATUS':<11}{'CREATED':<21}"
         lines = [header, "-" * len(header)]

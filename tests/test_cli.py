@@ -281,3 +281,20 @@ def test_select_project_invalid_choice_errors(project, monkeypatch, capsys):
     monkeypatch.setattr("sys.stdin.isatty", lambda: True)
     monkeypatch.setattr("builtins.input", lambda prompt="": "9")
     assert main(["select-project"]) == 1
+
+
+def test_runs_status_filter(project, capsys):
+    from distributeddeeplearning_tpu.control.runs import RunRegistry
+
+    registry = RunRegistry(project / "runs")
+    r1 = registry.new_run("e2e", "imagenet", "remote", [])
+    registry.update(r1, status="running")
+    r2 = registry.new_run("e2e", "bert", "local", [])
+    registry.update(r2, status="completed", returncode=0)
+
+    assert main(["runs", "--status", "running"]) == 0
+    out = capsys.readouterr().out
+    assert r1.run_id in out and r2.run_id not in out
+
+    assert main(["runs", "--status", "failed"]) == 0
+    assert "no failed runs" in capsys.readouterr().out
