@@ -204,9 +204,24 @@ def build_parser() -> argparse.ArgumentParser:
     )
     prep_p.add_argument("--train-tar", required=True)
     prep_p.add_argument("--val-tar", required=True)
-    prep_p.add_argument("--val-map", required=True)
+    prep_p.add_argument(
+        "--val-map", default=None,
+        help="filename<->wnid CSV; omitted = derive it from the "
+        "ILSVRC2012 devkit tar next to --val-tar (checksum-verified)",
+    )
     prep_p.add_argument("--target-dir", default=None)
     prep_p.add_argument("--no-checksum", action="store_true")
+    vm_p = st_sub.add_parser(
+        "val-maps",
+        help="Derive imagenet_val_maps.csv from the ILSVRC2012 devkit tar "
+        "(sha256-verified against the canonical map)",
+    )
+    vm_p.add_argument("--devkit", required=True)
+    vm_p.add_argument("--out", default="imagenet_val_maps.csv")
+    vm_p.add_argument(
+        "--no-verify", action="store_true",
+        help="write even if the sha256 does not match the canonical map",
+    )
     ci_p = st_sub.add_parser(
         "class-index",
         help="Derive the wnid->class mapping from the train tree; "
@@ -239,9 +254,23 @@ def build_parser() -> argparse.ArgumentParser:
 
     inter_p = sub.add_parser(
         "interactive",
-        help="Open an interactive shell on a pod worker (inv interactive)",
+        help="Open an interactive shell on a pod worker (inv interactive), "
+        "or --repl for a local Python session with the SDK objects preloaded",
     )
     inter_p.add_argument("--worker", default="0")
+    inter_p.add_argument(
+        "--repl", action="store_true",
+        help="operator-side IPython/Python REPL with cfg, runner, registry, "
+        "pod, submitter and storage in scope (the reference's `inv "
+        "interactive` opened exactly this against its SDK)",
+    )
+
+    comp_p = sub.add_parser(
+        "completion",
+        help="Print a shell completion script (install: ddlt completion "
+        "bash > /etc/bash_completion.d/ddlt)",
+    )
+    comp_p.add_argument("shell", choices=("bash", "zsh"))
 
     tb_p = sub.add_parser("tensorboard", help="TensorBoard over registry runs")
     tb_p.add_argument("--experiment", default=None)
@@ -327,6 +356,125 @@ def _submit(args, workload: str, extra: List[str]) -> int:
         )
     print(f"run {run.experiment}/{run.run_id}: {run.status}")
     return 0 if run.status == "completed" or args.dry_run else 1
+
+
+def _repl(cfg, runner, registry) -> int:
+    """Operator-side REPL with the control-plane SDK preloaded — the role of
+    the reference's ``inv interactive`` (IPython with the AML workspace
+    objects in scope, ``tasks.py:84-87``).  IPython when available, stdlib
+    ``code.interact`` otherwise."""
+    from distributeddeeplearning_tpu.control.storage import GcsStorage
+    from distributeddeeplearning_tpu.control.submit import Submitter
+    from distributeddeeplearning_tpu.control.tpu import pod_from_settings
+
+    namespace = {
+        "cfg": cfg,
+        "runner": runner,
+        "registry": registry,
+        "pod": pod_from_settings(cfg, runner),
+        "submitter": Submitter(cfg, runner, registry),
+    }
+    if cfg.get("GCS_BUCKET"):
+        namespace["storage"] = GcsStorage(runner, bucket=cfg.get("GCS_BUCKET"))
+    banner = (
+        "ddlt interactive REPL — preloaded: "
+        + ", ".join(sorted(namespace))
+        + "\n(e.g. pod.state(), submitter.poll_run(...), storage.exists())"
+    )
+    try:
+        from IPython import start_ipython
+        from traitlets.config import Config
+
+        # display_banner is a Bool trait; the banner TEXT goes through
+        # TerminalInteractiveShell.banner1.
+        config = Config()
+        config.TerminalInteractiveShell.banner1 = banner + "\n"
+        start_ipython(argv=[], user_ns=namespace, config=config)
+    except ImportError:
+        import code
+
+        code.interact(banner=banner, local=namespace)
+    return 0
+
+
+def _emit_completion(parser, shell: str) -> int:
+    """Print a bash/zsh completion script for the ``ddlt`` verb tree.
+
+    The reference bakes invoke's bash completion into its control image
+    (``control/Docker/bash.completion`` installed by
+    ``control/Docker/dockerfile``); here the script is GENERATED from the
+    live argparse tree (verbs, sub-verbs and flags are introspected, so it
+    never drifts from the CLI), and the control image installs it with
+    ``ddlt completion bash > /etc/bash_completion.d/ddlt``.
+    """
+
+    def subactions(p):
+        for action in p._actions:
+            if isinstance(action, argparse._SubParsersAction):
+                return action.choices
+        return {}
+
+    def flags(p):
+        out = []
+        for action in p._actions:
+            out.extend(s for s in action.option_strings if s.startswith("--"))
+        return out
+
+    top = subactions(parser)
+    lines = [
+        "# ddlt shell completion — generated by `ddlt completion %s`" % shell,
+        "_ddlt_complete() {",
+        '    local cur="${COMP_WORDS[COMP_CWORD]}"',
+        '    local verb="${COMP_WORDS[1]}"',
+        '    local sub="${COMP_WORDS[2]}"',
+        "    if [[ $COMP_CWORD -eq 1 ]]; then",
+        '        COMPREPLY=( $(compgen -W "%s" -- "$cur") )' % " ".join(sorted(top)),
+        "        return",
+        "    fi",
+        '    case "$verb" in',
+    ]
+    for name, p in sorted(top.items()):
+        nested = subactions(p)
+        words = sorted(set(list(nested) + flags(p)))
+        lines.append(f"    {name})")
+        if nested:
+            lines.append("        if [[ $COMP_CWORD -eq 2 ]]; then")
+            lines.append(
+                '            COMPREPLY=( $(compgen -W "%s" -- "$cur") ); return'
+                % " ".join(words)
+            )
+            lines.append("        fi")
+            lines.append('        case "$sub" in')
+            for sub_name, sub_p in sorted(nested.items()):
+                lines.append(
+                    f'        {sub_name}) COMPREPLY=( $(compgen -W '
+                    f'"{" ".join(sorted(flags(sub_p)))}" -- "$cur") ); return;;'
+                )
+            lines.append("        esac")
+            lines.append(
+                '        COMPREPLY=( $(compgen -W "%s" -- "$cur") );;'
+                % " ".join(sorted(flags(p)))
+            )
+        else:
+            lines.append(
+                '        COMPREPLY=( $(compgen -W "%s" -- "$cur") );;'
+                % " ".join(words)
+            )
+    lines += [
+        "    esac",
+        "}",
+        "complete -F _ddlt_complete ddlt",
+    ]
+    if shell == "zsh":
+        lines = [
+            "# zsh via bashcompinit",
+            "autoload -U +X bashcompinit && bashcompinit",
+        ] + lines
+    try:
+        print("\n".join(lines))
+    except BrokenPipeError:  # `ddlt completion bash | head` is fine
+        pass
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -426,24 +574,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         "imagenet", "bert", "transformer", "benchmark", "experiment"
     ):
         return _submit(args, args.command, extra)
+    if args.command == "completion":
+        return _emit_completion(parser, args.shell)
     if args.command == "interactive":
         from distributeddeeplearning_tpu.control.tpu import pod_from_settings
 
-        cfg, runner, _ = _control(args)
+        cfg, runner, registry = _control(args)
+        if args.repl:
+            return _repl(cfg, runner, registry)
         pod_from_settings(cfg, runner).interactive(worker=args.worker)
         return 0
     if args.command == "tensorboard":
         return _cmd_tensorboard(args)
     if args.command == "runs":
-        cfg, _, registry = _control(args)
+        cfg, runner, registry = _control(args)
         experiment = args.experiment or cfg.get("EXPERIMENT_NAME") or "experiment"
         if args.run:
             if getattr(args, "refresh", False):
                 from distributeddeeplearning_tpu.control.submit import Submitter
 
-                cfg2, runner2, registry = _control(args)
                 try:
-                    record = Submitter(cfg2, runner2, registry).poll_run(
+                    record = Submitter(cfg, runner, registry).poll_run(
                         experiment, args.run
                     )
                 except ValueError:
@@ -570,7 +721,7 @@ def _cmd_setup(args) -> int:
                 storage.upload_tfrecords(tfrecords_dir)
         print("setup complete (dry run)")
         return 0
-    if args.train_tar and args.val_tar and args.val_map:
+    if args.train_tar and args.val_tar:
         from distributeddeeplearning_tpu.data.prepare_imagenet import (
             prepare_imagenet,
         )
@@ -668,6 +819,22 @@ def _cmd_storage(args) -> int:
             args.val_map,
             check_sha1=not args.no_checksum,
         )
+        return 0
+
+    if verb == "val-maps":
+        if args.dry_run:
+            print(f"[dry-run] derive_val_maps({args.devkit}) -> {args.out}")
+            return 0
+        from distributeddeeplearning_tpu.data.val_maps import (
+            derive_val_maps,
+            write_val_maps,
+        )
+
+        digest = write_val_maps(
+            derive_val_maps(args.devkit), args.out,
+            verify=not args.no_verify,
+        )
+        print(f"{args.out}: sha256 {digest}")
         return 0
 
     if verb == "class-index":

@@ -122,6 +122,10 @@ class CommandRunner:
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
+            # Remote fan-out output is not guaranteed UTF-8 (worker locales,
+            # binary progress bars); strict decoding would kill the tee loop
+            # mid-run and strand the run as 'running'.
+            errors="replace",
             env=env,
             bufsize=1,  # line buffered
         ) as proc, open(log_path, "a") as log:

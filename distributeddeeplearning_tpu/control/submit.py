@@ -311,10 +311,16 @@ class Submitter:
         ``tasks.py`` ``runs`` lists it).  Here the submit process itself
         normally flips the status when the synchronous fan-out returns — but
         if the control process died (laptop closed, tmux killed), the run is
-        stranded in ``running``.  The poll asks worker 0 whether the
-        workload's launcher module is still alive and flips the registry
-        accordingly; completed/failed runs are returned untouched.
+        stranded in ``running``.  The poll probes EVERY worker for the
+        workload's launcher module and decides by quorum: any live launcher
+        keeps the run ``running`` (a transiently unreachable worker 0 must
+        not fail a healthy pod job), and the flip to ``failed`` requires a
+        confirmed-dead majority — per-worker liveness lands in
+        ``run.extra['poll_workers']`` either way.  Completed/failed runs are
+        returned untouched.
         """
+        import re as _re
+
         run = self.registry.find(experiment, run_id)
         if run is None:
             raise ValueError(f"unknown run {experiment}/{run_id}")
@@ -328,29 +334,49 @@ class Submitter:
             self.registry.update(run, status="failed")
             return run
         # Bracket the pattern's first char so pgrep cannot match the probe's
-        # own wrapping shell (whose cmdline also contains the module name).
-        pattern = f"[{module[0]}]{module[1:]}"
+        # own wrapping shell (whose cmdline also contains the module name);
+        # ERE-escape the rest — the module path's dots would otherwise match
+        # any character and could report an unrelated process as ALIVE.
+        pattern = f"[{module[0]}]{_re.escape(module[1:])}"
         probe = pod.ssh(
             f"pgrep -f '{pattern}' >/dev/null && echo ALIVE || echo DEAD",
-            worker="0",
+            worker="all",
             check=False,
         )
         out = probe.stdout or ""
-        if "ALIVE" in out:
-            return run  # genuinely still training
-        if not probe.ok or "DEAD" not in out:
-            # The PROBE failed (ssh blip, key propagation) — that says
-            # nothing about the workload; never flip a live run on it.
+        alive = out.count("ALIVE")
+        dead = out.count("DEAD")
+        expected = pod.topology["hosts"]
+        run.extra["poll_workers"] = {
+            "alive": alive, "dead": dead, "expected": expected,
+        }
+        # Persist the liveness snapshot on EVERY outcome (update() below
+        # rewrites the record only on the failed flip).
+        self.registry.update(run, status=run.status)
+        if alive:
+            if alive + dead < expected:
+                logger.warning(
+                    "run %s: %d/%d workers unreachable during poll; launcher "
+                    "alive on %d", run.run_id, expected - alive - dead,
+                    expected, alive,
+                )
+            return run  # genuinely still training somewhere
+        if dead * 2 <= expected:
+            # No confirmed-dead majority — too few workers answered DEAD
+            # (covers the all-probes-failed case, where dead == 0).  A
+            # half-blind probe says nothing about the workload; never flip
+            # a live run on it.
             logger.warning(
-                "run %s: status probe inconclusive (rc=%d); leaving status "
-                "as-is", run.run_id, probe.returncode,
+                "run %s: status probe inconclusive (rc=%d, %d/%d workers "
+                "answered); leaving status as-is",
+                run.run_id, probe.returncode, alive + dead, expected,
             )
             return run
-        # Confirmed: no launcher process.  The run ended without this
-        # registry hearing about it.  Without an exit code the safe claim is
-        # "failed" — a completed run's submit process would have recorded
-        # completion.
-        run.extra["poll"] = "no launcher process on worker 0"
+        # Confirmed: a majority of workers (and no minority dissent) report
+        # no launcher process.  The run ended without this registry hearing
+        # about it.  Without an exit code the safe claim is "failed" — a
+        # completed run's submit process would have recorded completion.
+        run.extra["poll"] = f"no launcher process on {dead}/{expected} workers"
         self.registry.update(run, status="failed")
         return run
 

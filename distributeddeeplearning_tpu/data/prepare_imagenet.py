@@ -72,14 +72,30 @@ def extract_train(train_tar: str, target_dir: str) -> int:
 
 
 def load_val_map(val_map_path: str) -> Dict[str, str]:
-    """filename → wnid from the CSV map (same row format as the reference's
-    ``imagenet_val_maps.csv``)."""
+    """filename → wnid from the CSV map.
+
+    Accepts BOTH column orders: the reference's ``class,filename``
+    (``{{proj}}/scripts/imagenet_val_maps.csv`` — wnid first) and the
+    transposed ``filename,wnid`` an operator may have produced.  The wnid
+    column is recognized by its ``n<8 digits>`` shape, so either file works
+    unchanged (the r03 loader silently rejected the reference's own format).
+    """
+    import re
+
+    wnid_re = re.compile(r"^n\d{8}$")
     mapping: Dict[str, str] = {}
     with open(val_map_path, newline="") as f:
         for row in csv.reader(f):
-            if len(row) < 2 or not row[1].startswith("n"):
+            if len(row) < 2:
+                continue
+            a, b = row[0].strip(), row[1].strip()
+            if wnid_re.match(a):
+                wnid, filename = a, b
+            elif wnid_re.match(b):
+                wnid, filename = b, a
+            else:
                 continue  # header or malformed
-            mapping[os.path.basename(row[0])] = row[1]
+            mapping[os.path.basename(filename)] = wnid
     if not mapping:
         raise ValueError(f"no filename,wnid rows found in {val_map_path}")
     return mapping
@@ -113,13 +129,29 @@ def prepare_imagenet(
     train_tar: str,
     val_tar: str,
     target_dir: str,
-    val_map_path: str,
+    val_map_path: Optional[str] = None,
     *,
     check_sha1: bool = True,
     expected_train_sha1: Optional[str] = TRAIN_TAR_SHA1,
     expected_val_sha1: Optional[str] = VAL_TAR_SHA1,
 ) -> None:
-    """Full preparation flow (``main``, ``prepare_imagenet.py:74-84``)."""
+    """Full preparation flow (``main``, ``prepare_imagenet.py:74-84``).
+
+    ``val_map_path=None`` derives the map from the devkit tarball sitting
+    next to ``val_tar`` (``data/val_maps.py`` — checksummed against the
+    reference's shipped CSV), which makes ``ddlt setup`` as turnkey as
+    ``inv setup`` without carrying the 1.5MB blob in-repo.
+    """
+    if val_map_path is None:
+        from distributeddeeplearning_tpu.data.val_maps import ensure_val_maps
+
+        val_map_path = ensure_val_maps(os.path.dirname(os.path.abspath(val_tar)))
+        if val_map_path is None:
+            raise FileNotFoundError(
+                "no val map CSV given and no ILSVRC2012_devkit_t12.tar.gz "
+                "found next to the val tar — download the devkit (it is "
+                "distributed alongside the image tars) or pass val_map_path"
+            )
     if check_sha1:
         verify_checksum(train_tar, expected_train_sha1)
         verify_checksum(val_tar, expected_val_sha1)

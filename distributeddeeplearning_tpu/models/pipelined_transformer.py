@@ -72,24 +72,52 @@ def _layer_norm(x, scale):
     return (x - mu) * jax.lax.rsqrt(var + 1e-6) * scale
 
 
-def block_apply(p: Dict[str, jax.Array], x: jax.Array, *, num_heads: int):
-    """One pre-LN transformer block; ``p`` leaves are per-layer ([...] no L)."""
+def block_apply(
+    p: Dict[str, jax.Array],
+    x: jax.Array,
+    *,
+    num_heads: int,
+    attention: str = "dense",
+):
+    """One pre-LN transformer block; ``p`` leaves are per-layer ([...] no L).
+
+    ``attention``: ``"dense"`` materializes the [b,h,s,s] score matrix with a
+    tril mask; ``"flash"`` runs the causal Pallas kernel
+    (``ops.flash_attention`` with ``causal=True``) — O(block²) memory and
+    ~half the FLOPs, the long-context decoder path.  Both are exact.
+    """
     b, s, d = x.shape
     hd = d // num_heads
 
     h = _layer_norm(x, p["ln1"])
     qkv = h @ p["qkv"]  # [b, s, 3d]
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    split = lambda t: t.reshape(b, s, num_heads, hd).transpose(0, 2, 1, 3)
-    q, k, v = split(q), split(k), split(v)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
-        jnp.asarray(hd, jnp.float32)
-    )
-    causal = jnp.tril(jnp.ones((s, s), bool))
-    scores = jnp.where(causal, scores, -1e30)
-    attn = jax.nn.softmax(scores, axis=-1)
-    ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
-    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, d)
+    if attention == "flash":
+        from distributeddeeplearning_tpu.ops.flash_attention import (
+            flash_attention,
+        )
+
+        split4 = lambda t: t.reshape(b, s, num_heads, hd)  # noqa: E731
+        ctx = flash_attention(
+            split4(q), split4(k), split4(v), None, dtype=x.dtype, causal=True
+        ).reshape(b, s, d)
+    elif attention == "dense":
+        split = lambda t: t.reshape(b, s, num_heads, hd).transpose(0, 2, 1, 3)  # noqa: E731
+        q, k, v = split(q), split(k), split(v)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(hd, jnp.float32)
+        )
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(causal, scores, -1e30)
+        # softmax in f32 (scores were promoted by the f32 scale), then back
+        # to the stream dtype — without the cast a bf16 residual stream
+        # would silently promote to f32 and break the scan-over-layers
+        # carry contract.
+        attn = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, d).astype(x.dtype)
+    else:
+        raise ValueError(f"unknown attention {attention!r}")
     x = x + ctx @ p["proj"]
 
     h = _layer_norm(x, p["ln2"])
@@ -97,11 +125,18 @@ def block_apply(p: Dict[str, jax.Array], x: jax.Array, *, num_heads: int):
     return x
 
 
-def _stack_scan(blocks: PyTree, x: jax.Array, *, num_heads: int) -> jax.Array:
+def _stack_scan(
+    blocks: PyTree, x: jax.Array, *, num_heads: int, attention: str = "dense"
+) -> jax.Array:
     """lax.scan over the stacked layer dim — one compiled block body."""
 
     def body(carry, layer_params):
-        return block_apply(layer_params, carry, num_heads=num_heads), None
+        return (
+            block_apply(
+                layer_params, carry, num_heads=num_heads, attention=attention
+            ),
+            None,
+        )
 
     out, _ = jax.lax.scan(body, x, blocks)
     return out
@@ -117,10 +152,10 @@ def _embed(params, tokens):
     return x + params["pos"][: tokens.shape[1]][None]
 
 
-def forward(params, tokens, *, num_heads: int) -> jax.Array:
+def forward(params, tokens, *, num_heads: int, attention: str = "dense") -> jax.Array:
     """Next-token logits [b, s, vocab] — sequential (scan over all layers)."""
     x = _embed(params, tokens)
-    x = _stack_scan(params["blocks"], x, num_heads=num_heads)
+    x = _stack_scan(params["blocks"], x, num_heads=num_heads, attention=attention)
     return x @ params["head"]
 
 
@@ -132,8 +167,14 @@ def forward_pipelined(
     mesh,
     num_microbatches: int,
     remat: bool = False,
+    attention: str = "dense",
 ) -> jax.Array:
-    """Same function, stages sharded over the mesh's ``pipe`` axis."""
+    """Same function, stages sharded over the mesh's ``pipe`` axis.
+
+    ``attention="flash"`` runs the causal Pallas kernel inside each stage —
+    the kernel executes per-shard inside pipeline_apply's shard_map, so no
+    extra mesh plumbing is needed.
+    """
     from distributeddeeplearning_tpu.ops.pipeline import pipeline_apply
 
     n_stages = int(mesh.shape["pipe"])
@@ -146,7 +187,9 @@ def forward_pipelined(
     )
 
     def stage_fn(stage_params, x):
-        return _stack_scan(stage_params, x, num_heads=num_heads)
+        return _stack_scan(
+            stage_params, x, num_heads=num_heads, attention=attention
+        )
 
     x = _embed(params, tokens)
     x = pipeline_apply(

@@ -48,8 +48,17 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _causal_tile_bias(row0, col0, bq, bk):
+    """Additive triangle mask for one [bq, bk] score tile at global offsets
+    (row0, col0): 0 where key_pos <= query_pos, NEG_BIG above the diagonal."""
+    rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(cols <= rows, 0.0, NEG_BIG).astype(jnp.float32)
+
+
 def _kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-            *, scale: float):
+            *, scale: float, causal: bool):
+    qi = pl.program_id(1)
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -58,28 +67,45 @@ def _kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0]  # [bq, D] — native dtype: bf16 inputs ride the MXU's
-    k = k_ref[0]  # bf16×bf16→f32 path; casting to f32 first would quarter
-    v = v_ref[0]  # the matmul rate
-    s = (
-        jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        * scale
-    )  # [bq, bk] f32
-    s = s + bias_ref[0, 0][None, :]  # additive key-padding bias (0 or NEG_BIG)
+    def _compute():
+        q = q_ref[0]  # [bq, D] — native dtype: bf16 inputs ride the MXU's
+        k = k_ref[0]  # bf16×bf16→f32 path; casting to f32 first would quarter
+        v = v_ref[0]  # the matmul rate
+        bq, bk = q.shape[0], k.shape[0]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [bq, bk] f32
+        s = s + bias_ref[0, 0][None, :]  # additive key-padding bias
+        if causal:
+            s = s + _causal_tile_bias(qi * bq, ki * bk, bq, bk)
 
-    m_prev = m_ref[:, :1]  # [bq, 1]
-    m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-    p = jnp.exp(s - m_cur)
-    correction = jnp.exp(m_prev - m_cur)
-    l_new = l_ref[:, :1] * correction + p.sum(axis=-1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
-    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        m_prev = m_ref[:, :1]  # [bq, 1]
+        m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_cur)
+        correction = jnp.exp(m_prev - m_cur)
+        l_new = l_ref[:, :1] * correction + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # Whole-tile skip past the diagonal: k block ki contributes to q
+        # block qi only when its first key position can be <= some query
+        # position in the block — for the square grid this drops ~half the
+        # tiles' matmuls (the causal-FLOP saving).  The accumulators simply
+        # carry through skipped steps.
+        bq = q_ref.shape[1]
+        bk = k_ref.shape[1]
+        pl.when(ki * bk <= qi * bq + bq - 1)(_compute)
+    else:
+        _compute()
 
     @pl.when(ki == pl.num_programs(2) - 1)
     def _finalize():
@@ -89,7 +115,7 @@ def _kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref
 
 
 def _flash_fwd_pallas(q3, k3, v3, bias2, *, heads: int, block_q: int,
-                      block_k: int, out_dtype):
+                      block_k: int, out_dtype, causal: bool = False):
     """q3/k3/v3: [BH, S, D]; bias2: [B, S] f32 → (o [BH,S,D], lse [BH,S])."""
     if pltpu is None:  # pragma: no cover
         raise RuntimeError("pallas TPU support unavailable in this jax build")
@@ -97,7 +123,7 @@ def _flash_fwd_pallas(q3, k3, v3, bias2, *, heads: int, block_q: int,
     scale = 1.0 / (d ** 0.5)
     grid = (bh, s // block_q, s // block_k)
 
-    kernel = functools.partial(_kernel, scale=scale)
+    kernel = functools.partial(_kernel, scale=scale, causal=causal)
     compiler_params = None
     if not _use_interpret():
         compiler_params = pltpu.CompilerParams(
@@ -140,36 +166,49 @@ def _flash_fwd_pallas(q3, k3, v3, bias2, *, heads: int, block_q: int,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, acc_ref, *, scale: float):
+                   dq_ref, acc_ref, *, scale: float, causal: bool):
     """dq pass: one q block resident, stream k/v blocks (grid dim 2)."""
+    qi = pl.program_id(1)
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0, 0]      # [bq]
-    delta = delta_ref[0, 0]  # [bq] = rowsum(dO ⊙ O)
-    s = (
-        jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0]      # [bq]
+        delta = delta_ref[0, 0]  # [bq] = rowsum(dO ⊙ O)
+        bq, bk = q.shape[0], k.shape[0]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+            + bias_ref[0, 0][None, :]
         )
-        * scale
-        + bias_ref[0, 0][None, :]
-    )
-    p = jnp.exp(s - lse[:, None])  # exact probs from the saved logsumexp
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    ds = p * (dp - delta[:, None]) * scale
-    acc_ref[:] += jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+        if causal:
+            s = s + _causal_tile_bias(qi * bq, ki * bk, bq, bk)
+        p = jnp.exp(s - lse[:, None])  # exact probs from the saved logsumexp
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        bq = q_ref.shape[1]
+        bk = k_ref.shape[1]
+        pl.when(ki * bk <= qi * bq + bq - 1)(_compute)
+    else:
+        _compute()
 
     @pl.when(ki == pl.num_programs(2) - 1)
     def _finalize():
@@ -177,42 +216,64 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float):
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                    causal: bool):
     """dk/dv pass: one k block resident, stream q blocks (grid dim 2).
     Works transposed ([bk, bq] tiles) so the accumulators index by key."""
-    qi = pl.program_id(2)
+    ci = pl.program_id(1)  # k-block index (resident)
+    qi = pl.program_id(2)  # q-block index (streamed)
 
     @pl.when(qi == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0, 0]      # [bq]
-    delta = delta_ref[0, 0]  # [bq]
-    st = (
-        jax.lax.dot_general(
-            k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0]      # [bq]
+        delta = delta_ref[0, 0]  # [bq]
+        bq, bk = q.shape[0], k.shape[0]
+        st = (
+            jax.lax.dot_general(
+                k, q, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+            + bias_ref[0, 0][:, None]
+        )  # [bk, bq]
+        if causal:
+            # transposed tile: rows are keys (global ci*bk+r), cols are
+            # queries (global qi*bq+c); key visible when key_pos <= query_pos
+            keys = ci * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
+            queries = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
+            st = st + jnp.where(keys <= queries, 0.0, NEG_BIG).astype(
+                jnp.float32
+            )
+        pt = jnp.exp(st - lse[None, :])
+        dv_acc[:] += jax.lax.dot_general(
+            pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
-        * scale
-        + bias_ref[0, 0][:, None]
-    )  # [bk, bq]
-    pt = jnp.exp(st - lse[None, :])
-    dv_acc[:] += jax.lax.dot_general(
-        pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    dpt = jax.lax.dot_general(
-        v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # [bk, bq]
-    dst = pt * (dpt - delta[None, :]) * scale
-    dk_acc[:] += jax.lax.dot_general(
-        dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+        dpt = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bk, bq]
+        dst = pt * (dpt - delta[None, :]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # k block ci receives gradient only from q blocks whose LAST query
+        # position reaches it: qi*bq + bq - 1 >= ci*bk.
+        bq = q_ref.shape[1]
+        bk = k_ref.shape[1]
+        pl.when(qi * bq + bq - 1 >= ci * bk)(_compute)
+    else:
+        _compute()
 
     @pl.when(qi == pl.num_programs(2) - 1)
     def _finalize():
@@ -221,7 +282,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q3, k3, v3, bias2, o3, lse, do3, *, heads: int,
-                      block_q: int, block_k: int):
+                      block_q: int, block_k: int, causal: bool = False):
     """FlashAttention-2 backward: (dq, dk, dv), each [BH, S, D]."""
     if pltpu is None:  # pragma: no cover
         raise RuntimeError("pallas TPU support unavailable in this jax build")
@@ -247,7 +308,7 @@ def _flash_bwd_pallas(q3, k3, v3, bias2, o3, lse, do3, *, heads: int,
     )
     row_spec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
     dq3 = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale),
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal),
         grid=(bh, s // block_q, s // block_k),
         in_specs=[q_spec, k_spec, k_spec, bias_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
@@ -266,7 +327,7 @@ def _flash_bwd_pallas(q3, k3, v3, bias2, o3, lse, do3, *, heads: int,
     )
     row_spec2 = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, j))
     dk3, dv3 = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale),
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal),
         grid=(bh, s // block_k, s // block_q),
         in_specs=[
             q_spec2, k_spec2, k_spec2, bias_spec2, q_spec2, row_spec2, row_spec2
@@ -286,19 +347,20 @@ def _flash_bwd_pallas(q3, k3, v3, bias2, o3, lse, do3, *, heads: int,
     return dq3, dk3, dv3
 
 
-def _make_core(heads: int, block_q: int, block_k: int, out_dtype):
+def _make_core(heads: int, block_q: int, block_k: int, out_dtype,
+               causal: bool = False):
     @jax.custom_vjp
     def core(q3, k3, v3, bias2):
         o, _ = _flash_fwd_pallas(
             q3, k3, v3, bias2, heads=heads, block_q=block_q,
-            block_k=block_k, out_dtype=out_dtype,
+            block_k=block_k, out_dtype=out_dtype, causal=causal,
         )
         return o
 
     def fwd(q3, k3, v3, bias2):
         o, lse = _flash_fwd_pallas(
             q3, k3, v3, bias2, heads=heads, block_q=block_q,
-            block_k=block_k, out_dtype=out_dtype,
+            block_k=block_k, out_dtype=out_dtype, causal=causal,
         )
         return o, (q3, k3, v3, bias2, o, lse)
 
@@ -306,7 +368,7 @@ def _make_core(heads: int, block_q: int, block_k: int, out_dtype):
         q3, k3, v3, bias2, o, lse = res
         dq, dk, dv = _flash_bwd_pallas(
             q3, k3, v3, bias2, o, lse, do.astype(q3.dtype),
-            heads=heads, block_q=block_q, block_k=block_k,
+            heads=heads, block_q=block_q, block_k=block_k, causal=causal,
         )
         return dq, dk, dv, jnp.zeros_like(bias2)
 
@@ -323,12 +385,19 @@ def flash_attention(
     dtype: jnp.dtype,
     block_q: int = 512,
     block_k: int = 512,
+    causal: bool = False,
 ) -> jax.Array:
     """Drop-in for ``models.bert.dot_product_attention``: [B, S, H, D] in/out.
 
     ``mask``: bool, broadcastable to [B, 1, 1, S] (key padding).  Blocks
     clamp to the sequence length; S must be divisible by the (clamped)
     block sizes.
+
+    ``causal=True`` applies the autoregressive triangle (key_pos <=
+    query_pos) INSIDE the kernel — fully-masked k-tiles skip their matmuls
+    entirely (≈2× fewer FLOPs at long S), the diagonal tiles mask
+    elementwise, and the same skip logic runs in both backward passes.
+    Composes with the key-padding ``mask``.
     """
     b, s, h, d = q.shape
     block_q = min(block_q, s)
@@ -344,12 +413,13 @@ def flash_attention(
         bias2 = jnp.where(key_mask, 0.0, NEG_BIG).astype(jnp.float32)
 
     to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)  # noqa: E731
-    core = _make_core(h, block_q, block_k, dtype)
+    core = _make_core(h, block_q, block_k, dtype, causal)
     o3 = core(to3(q), to3(k), to3(v), bias2)
     return o3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-def make_flash_attention(block_q: int = 512, block_k: int = 512, mesh=None):
+def make_flash_attention(block_q: int = 512, block_k: int = 512, mesh=None,
+                         causal: bool = False):
     """Bind block sizes → an ``attention_fn`` for the transformer models.
 
     With a multi-device ``mesh`` the kernel runs per-shard inside
@@ -357,11 +427,14 @@ def make_flash_attention(block_q: int = 512, block_k: int = 512, mesh=None):
     sequence replicated (sequence sharding is :func:`ops.ring_attention`'s
     job).  A bare ``pallas_call`` cannot be partitioned by GSPMD, so without
     this wrap a sharded caller would gather the global batch onto every chip.
+
+    ``causal=True`` binds the in-kernel triangle mask (decoder models).
     """
 
     def _local(q, k, v, mask, dtype):
         return flash_attention(
-            q, k, v, mask, dtype=dtype, block_q=block_q, block_k=block_k
+            q, k, v, mask, dtype=dtype, block_q=block_q, block_k=block_k,
+            causal=causal,
         )
 
     def attention_fn(q, k, v, mask, *, dtype):

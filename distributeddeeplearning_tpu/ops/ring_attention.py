@@ -34,7 +34,31 @@ from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
 _NEG_BIG = -1e30  # finite mask fill; -inf poisons the online-softmax max
 
 
-def _ring_body(q, k, v, mask, *, axis_name: str, ring: int, out_dtype):
+def _online_update(q, k, v, mask_blk, m, l, o, scale):
+    """One online-softmax accumulation of a k/v block into (m, l, o).
+
+    q ``[B, Sq, H, D]``; k, v ``[B, Sk, H, D]``; mask_blk ``[B, 1, 1, Sk]``;
+    m, l ``[B, H, Sq]`` f32; o ``[B, Sq, H, D]`` f32.  The same recurrence
+    serves both loops of the ring: over ring ticks (device-sized blocks)
+    and, when ``block_k`` is set, over sub-blocks within a tick.
+    """
+    scores = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
+    scores = jnp.where(mask_blk, scores, _NEG_BIG)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    p = jnp.exp(scores - m_new[..., None])
+    correction = jnp.exp(m - m_new)
+    l = l * correction + p.sum(axis=-1)
+    o = o * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+    )
+    return m_new, l, o
+
+
+def _ring_body(q, k, v, mask, *, axis_name: str, ring: int, out_dtype,
+               block_k: Optional[int] = None):
     """Per-shard blockwise attention with rotating k/v (runs in shard_map).
 
     Shapes (local shard): q ``[B, Sq, H, D]``; k, v ``[B, Skv, H, D]``;
@@ -48,11 +72,22 @@ def _ring_body(q, k, v, mask, *, axis_name: str, ring: int, out_dtype):
     Only k/v rotate.  The key-padding mask is all-gathered ONCE (bool
     ``[B, 1, 1, S]`` — bits, not activations) and indexed by each step's
     source rank, replacing a third per-step ppermute buffer.
+
+    ``block_k`` bounds the materialized score tile: the tick's Skv keys are
+    consumed in an INNER scan of ``block_k``-sized chunks through the same
+    online recurrence, so peak score memory is O(Sq·block_k) instead of the
+    whole-tick O(Sq·Skv) = O(S²/n²) — the flash-attention blocking composed
+    with the ring (VERDICT r03 #8).  Exact for any block size; None keeps
+    the single-tile tick (fastest when S/n is already small).
     """
     depth = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(depth, jnp.float32))
     b, sq, h, _ = q.shape
     skv = k.shape[1]
+    if block_k is not None and (block_k <= 0 or skv % block_k):
+        raise ValueError(
+            f"block_k {block_k} must divide the local kv length {skv}"
+        )
 
     m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, sq), jnp.float32)
@@ -69,23 +104,31 @@ def _ring_body(q, k, v, mask, *, axis_name: str, ring: int, out_dtype):
         # rank (rank - r) mod ring; slice that block's key-padding mask
         src = jax.lax.rem(rank - r + ring, ring)
         mask_r = jax.lax.dynamic_slice_in_dim(mask_all, src * skv, skv, axis=3)
-        scores = (
-            jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
-            * scale
-        )
-        scores = jnp.where(mask_r, scores, _NEG_BIG)
-        m_new = jnp.maximum(m, scores.max(axis=-1))
-        p = jnp.exp(scores - m_new[..., None])
-        correction = jnp.exp(m - m_new)
-        l = l * correction + p.sum(axis=-1)
-        o = o * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
-            "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
-        )
+        if block_k is None or block_k >= skv:
+            m, l, o = _online_update(q, k, v, mask_r, m, l, o, scale)
+        else:
+            nchunks = skv // block_k
+            # [nchunks, B, block_k, H, D] — leading scan axis
+            k_c = k.reshape(b, nchunks, block_k, h, depth).swapaxes(0, 1)
+            v_c = v.reshape(b, nchunks, block_k, h, depth).swapaxes(0, 1)
+            mask_c = mask_r.reshape(b, 1, 1, nchunks, block_k).transpose(
+                3, 0, 1, 2, 4
+            )
+
+            def chunk_fn(inner, xs):
+                im, il, io = inner
+                kc, vc, mc = xs
+                im, il, io = _online_update(q, kc, vc, mc, im, il, io, scale)
+                return (im, il, io), None
+
+            (m, l, o), _ = jax.lax.scan(
+                chunk_fn, (m, l, o), (k_c, v_c, mask_c)
+            )
         # Unconditional rotation (uniform scan body; the final one returns
         # k/v to their home shard, so the op leaves no residual rotation).
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
-        return (k, v, m_new, l, o), None
+        return (k, v, m, l, o), None
 
     (_, _, m, l, o), _ = jax.lax.scan(
         step_fn, (k, v, m0, l0, o0), jnp.arange(ring)
@@ -105,12 +148,18 @@ def ring_attention(
     mesh: Mesh,
     dtype: jnp.dtype,
     axis_name: str = "seq",
+    block_k: Optional[int] = None,
 ):
     """Sequence-parallel attention over ``mesh``'s ``axis_name`` ring.
 
     Drop-in for :func:`models.bert.dot_product_attention` given a mesh:
     inputs are global ``[B, S, H, D]`` arrays (sharded batch over the data
     axes, sequence over ``seq``); output has the same layout.
+
+    ``block_k`` enables the flash-style blocked inner loop (see
+    ``_ring_body``): per-device score memory O(Sq·block_k) instead of
+    O(S²/n²) per tick — required once S/n alone is big (seq-64k over 8
+    chips = 8k×8k f32 scores/tick/head unblocked).
     """
     from distributeddeeplearning_tpu.parallel.compat import shard_map
 
@@ -130,6 +179,7 @@ def ring_attention(
         axis_name=axis_name,
         ring=int(mesh.shape[axis_name]),
         out_dtype=dtype,
+        block_k=block_k,
     )
     return shard_map(
         body,
@@ -139,12 +189,15 @@ def ring_attention(
     )(q, k, v, mask)
 
 
-def make_ring_attention(mesh: Mesh, axis_name: str = "seq"):
+def make_ring_attention(
+    mesh: Mesh, axis_name: str = "seq", block_k: Optional[int] = None
+):
     """Bind a mesh → an ``attention_fn`` for the transformer models."""
 
     def attention_fn(q, k, v, mask, *, dtype):
         return ring_attention(
-            q, k, v, mask, mesh=mesh, dtype=dtype, axis_name=axis_name
+            q, k, v, mask, mesh=mesh, dtype=dtype, axis_name=axis_name,
+            block_k=block_k,
         )
 
     return attention_fn

@@ -121,3 +121,77 @@ def run_benchmark(
         for line in result.summary_lines():
             log(line)
     return result
+
+
+def run_data_benchmark(
+    step_fn: Callable,
+    state,
+    device_batches,
+    *,
+    model_name: str = "model",
+    batch_size_per_chip: int = 64,
+    num_devices: Optional[int] = None,
+    num_warmup_batches: int = 10,
+    num_iters: int = 10,
+    num_batches_per_iter: int = 10,
+    log: Optional[Callable[[str], None]] = None,
+) -> BenchmarkResult:
+    """Benchmark the step fed from a REAL input pipeline.
+
+    Identical methodology to :func:`run_benchmark` except each step consumes
+    the next batch from ``device_batches`` (an iterator of mesh-placed
+    batches, e.g. ``utils.prefetch.prefetch_to_device`` over an input_fn) —
+    so the number includes TFRecord read, JPEG decode, host→HBM transfer and
+    any pipeline stalls, exactly the end-to-end rate a training run sees.
+    The reference never isolates this (its input path is timed only inside
+    full training runs); measuring it directly is how the synthetic-vs-fed
+    gap in ``BENCH_DATA_*.json`` is produced.
+
+    Raises ``StopIteration`` if the pipeline runs dry before
+    ``num_warmup_batches + num_iters*num_batches_per_iter`` batches; size the
+    dataset (or use a repeating pipeline) accordingly.
+    """
+    if num_devices is None:
+        num_devices = world_size()
+    global_batch = batch_size_per_chip * num_devices
+    it = iter(device_batches)
+
+    if log:
+        log(f"Running data-fed warmup ({num_warmup_batches} batches)...")
+    metrics = None
+    for _ in range(num_warmup_batches):
+        state, metrics = step_fn(state, next(it))
+    if metrics is not None:
+        float(metrics["loss"])
+
+    if log:
+        log(
+            f"Running data-fed benchmark ({num_iters} iters x "
+            f"{num_batches_per_iter} batches)..."
+        )
+    img_secs: List[float] = []
+    iter_times: List[float] = []
+    for _ in range(num_iters):
+        t0 = time.perf_counter()
+        for _ in range(num_batches_per_iter):
+            state, metrics = step_fn(state, next(it))
+        float(metrics["loss"])  # sync
+        dt = time.perf_counter() - t0
+        iter_times.append(dt)
+        img_secs.append(global_batch * num_batches_per_iter / dt / num_devices)
+
+    mean = statistics.fmean(img_secs)
+    stdev = statistics.stdev(img_secs) if len(img_secs) > 1 else 0.0
+    result = BenchmarkResult(
+        model=model_name,
+        batch_size_per_chip=batch_size_per_chip,
+        num_devices=num_devices,
+        img_sec_per_chip_mean=mean,
+        img_sec_per_chip_ci95=1.96 * stdev,
+        img_sec_total=mean * num_devices,
+        iter_times_s=iter_times,
+    )
+    if log:
+        for line in result.summary_lines():
+            log(line)
+    return result

@@ -129,6 +129,11 @@ class TrainerConfig:
     global_batch_size: int = 0
     log_every: int = 100  # ExamplesPerSecondHook cadence (utils.py:23)
     checkpoint_dir: Optional[str] = None
+    # Save inside the step loop every N true steps (in addition to the
+    # epoch-end save).  At pod scale an epoch is ~1,250 steps; without this
+    # a preemption re-does up to a full epoch.  Resume lands on the EXACT
+    # step (see fit's step-indexed factory for replay-free data resume).
+    checkpoint_every_steps: Optional[int] = None
     tensorboard_dir: Optional[str] = None
     resume: bool = True
     max_to_keep: int = 5
@@ -145,6 +150,31 @@ class TrainerConfig:
     # device_puts the next N train batches while the device executes the
     # current one (utils/prefetch.py).  0 disables (synchronous fetch).
     prefetch: int = 2
+    # Multi-host eval buffers the local eval split in host RAM to agree on a
+    # common batch count with ONE allgather (see Trainer.evaluate); this caps
+    # how many batches may be buffered.  The default comfortably covers
+    # ImageNet-val-sized eval splits; raise it deliberately for bigger eval
+    # sets (or set eval_steps, which bounds the drain outright).
+    eval_buffer_batches: int = 4096
+
+
+def _drain_bounded(batches: Iterator, limit, cap: int) -> list:
+    """Buffer up to ``limit`` batches, refusing to exceed ``cap`` — the
+    multi-host eval drain's RAM guard (an eval split larger than expected
+    must fail loudly, not swap the host)."""
+    local: list = []
+    for batch in batches:
+        local.append(batch)
+        if limit is not None and len(local) >= limit:
+            break
+        if len(local) > cap:
+            raise RuntimeError(
+                f"multi-host eval buffered more than eval_buffer_batches="
+                f"{cap} batches on this host; set TrainerConfig.eval_steps "
+                "to bound the eval pass, or raise eval_buffer_batches if "
+                "the host has RAM for a larger eval split"
+            )
+    return local
 
 
 @dataclasses.dataclass
@@ -186,20 +216,37 @@ class Trainer:
     def fit(
         self,
         state,
-        train_batches: Iterator[Batch],
+        train_batches,
         eval_batches_factory: Optional[Callable[[], Iterator[Batch]]] = None,
     ) -> tuple:
-        """Run the epoch loop; returns (final_state, FitResult)."""
+        """Run the epoch loop; returns (final_state, FitResult).
+
+        ``train_batches`` is either a batch iterator or a STEP-INDEXED
+        factory ``f(start_step) -> Iterator`` (its first yield is the batch
+        for true step ``start_step``).  The factory form is what makes
+        mid-epoch resume exact: after restoring step k the factory is asked
+        for the stream starting at k, so no batch repeats and no batch is
+        skipped — replay-free for indexable pipelines (synthetic, raw
+        cache).  A plain iterator resumes wherever the stream happens to be
+        (the r03 behavior): correct for IID-shuffled repeat streams, but
+        not bit-reproducible against an uninterrupted run.
+        """
         cfg = self.config
         start_epoch = 0
+        start_step_in_epoch = 0
+        restored_step = None
         if self.checkpointer is not None and cfg.resume:
             state, restored_step = self.checkpointer.restore(state)
             if restored_step is not None:
                 start_epoch = int(restored_step) // cfg.steps_per_epoch
+                start_step_in_epoch = int(restored_step) % cfg.steps_per_epoch
                 if is_primary():
                     logger.info(
-                        "resuming from step %d (epoch %d)", restored_step, start_epoch
+                        "resuming from step %d (epoch %d, step %d within it)",
+                        restored_step, start_epoch, start_step_in_epoch,
                     )
+        if callable(train_batches) and not hasattr(train_batches, "__next__"):
+            train_batches = train_batches(int(restored_step or 0))
 
         owned_prefetch = None
         if cfg.prefetch > 0:
@@ -213,7 +260,8 @@ class Trainer:
 
         try:
             return self._fit_inner(
-                state, train_batches, eval_batches_factory, start_epoch
+                state, train_batches, eval_batches_factory, start_epoch,
+                start_step_in_epoch,
             )
         finally:
             if owned_prefetch is not None:
@@ -222,9 +270,17 @@ class Trainer:
                 # consumed (and keeps running during error handling if the
                 # loop raised).
                 owned_prefetch.close()
+            if self.checkpointer is not None:
+                # Drain pending async saves even when the loop raised (data
+                # stream died, preemption signal, ...): the state snapshots
+                # were already copied to host, and finalizing them is the
+                # difference between resuming at the last
+                # checkpoint_every_steps boundary and losing it.
+                self.checkpointer.wait()
 
     def _fit_inner(
-        self, state, train_batches, eval_batches_factory, start_epoch
+        self, state, train_batches, eval_batches_factory, start_epoch,
+        start_step_in_epoch=0,
     ) -> tuple:
         cfg = self.config
         tracker = ExamplesPerSecondTracker(
@@ -240,7 +296,10 @@ class Trainer:
         epoch = start_epoch
         profile_active = False
         profile_pending = cfg.profile_dir is not None and is_primary()
-        total_steps = (cfg.epochs - start_epoch) * cfg.steps_per_epoch
+        total_steps = (
+            (cfg.epochs - start_epoch) * cfg.steps_per_epoch
+            - start_step_in_epoch
+        )
         profile_start = cfg.profile_start
         if profile_pending and total_steps <= cfg.profile_start:
             logger.warning(
@@ -258,7 +317,9 @@ class Trainer:
             # gap between Trainer.fit and the benchmark harness throughput.
             acc = None
             epoch_t0 = time.monotonic()
-            for step_i in range(cfg.steps_per_epoch):
+            first_step = start_step_in_epoch if epoch == start_epoch else 0
+            steps_this_epoch = cfg.steps_per_epoch - first_step
+            for step_i in range(first_step, cfg.steps_per_epoch):
                 if profile_pending and global_step >= profile_start:
                     jax.profiler.start_trace(cfg.profile_dir)
                     profile_active, profile_pending = True, False
@@ -277,6 +338,18 @@ class Trainer:
                     jax.profiler.stop_trace()
                     profile_active = False
                     logger.info("profiler trace written to %s", cfg.profile_dir)
+                if (
+                    self.checkpointer is not None
+                    and cfg.checkpoint_every_steps
+                    and (epoch * cfg.steps_per_epoch + step_i + 1)
+                    % cfg.checkpoint_every_steps == 0
+                ):
+                    # save() copies device→host synchronously, so the next
+                    # step's donation cannot clobber the saved buffers; the
+                    # serialize/write happens on orbax's background thread.
+                    self.checkpointer.save(
+                        epoch * cfg.steps_per_epoch + step_i + 1, state
+                    )
             if profile_active:
                 # Run shorter than the window: close the trace on step work
                 # only — eval/checkpoint/TB below must not pollute it.
@@ -285,7 +358,7 @@ class Trainer:
                 profile_active = False
                 logger.info("profiler trace written to %s", cfg.profile_dir)
             train_metrics = {
-                k: float(v) / cfg.steps_per_epoch for k, v in acc.items()
+                k: float(v) / steps_this_epoch for k, v in acc.items()
             }
             # train-phase wall of THIS epoch (the float() above synced):
             # excludes the eval/checkpoint below, so per-epoch throughput
@@ -316,7 +389,7 @@ class Trainer:
             if eval_metrics:
                 row.update({f"val_{k}": v for k, v in eval_metrics.items()})
             row["images_per_second"] = (
-                cfg.steps_per_epoch * cfg.global_batch_size
+                steps_this_epoch * cfg.global_batch_size
             ) / max(epoch_train_wall, 1e-9)
             if epoch == start_epoch:
                 # The first epoch's wall includes train_step JIT compilation
@@ -364,11 +437,11 @@ class Trainer:
             # Drain (up to eval_steps) locally first: eval epochs are small
             # (ImageNet val = 50k images / pod) so buffering batch dicts of
             # host numpy arrays is cheap, and it turns N allgathers into 1.
-            local: list = []
-            for batch in eval_batches:
-                local.append(batch)
-                if limit is not None and len(local) >= limit:
-                    break
+            # The eval_buffer_batches cap keeps an unexpectedly large eval
+            # split from silently eating host RAM — fail loudly instead.
+            local = _drain_bounded(
+                eval_batches, limit, self.config.eval_buffer_batches
+            )
             common = int(
                 multihost_utils.process_allgather(
                     np.asarray(len(local))

@@ -169,6 +169,7 @@ def build_train_step(
     rng: Optional[jax.Array] = None,
     moe_aux_weight: float = 0.01,  # Switch Transformer's α
     accum_steps: int = 1,
+    input_transform: Optional[Callable] = None,
 ) -> Callable:
     """Compile the full DP training step over ``mesh``.
 
@@ -180,6 +181,12 @@ def build_train_step(
 
     ``rng`` seeds per-step stochastic layers (dropout); each step folds the
     step counter in, so resume at step k reproduces step k's dropout mask.
+
+    ``input_transform`` runs on the inputs INSIDE the compiled step, before
+    the compute-dtype cast — the hook for preprocessing that should ride the
+    TPU instead of the host (e.g. ``raw_cache.uint8_normalizer()`` casting
+    raw uint8 pixels and subtracting channel means; XLA fuses it into the
+    first layer's input chain).
 
     ``accum_steps`` > 1 microbatches the step: the global batch is split into
     ``accum_steps`` equal slices along the batch axis and a ``lax.scan``
@@ -202,6 +209,8 @@ def build_train_step(
 
     def step_fn(state, batch):
         inputs = batch.get("image", batch.get("input"))
+        if input_transform is not None:
+            inputs = input_transform(inputs)
         labels = batch["label"]
         extras = {k: batch[k] for k in EXTRA_INPUT_KEYS if k in batch}
         step_rng = jax.random.fold_in(base_rng, state.step)
@@ -308,6 +317,7 @@ def build_eval_step(
     logical_axes: Optional[PyTree] = None,
     loss_fn: Callable = cross_entropy_loss,
     metrics_fn: Callable = classification_metrics,
+    input_transform: Optional[Callable] = None,
 ) -> Callable:
     """Compile the eval step: forward + loss/top1/top5, no state mutation
     (parity with ``validate`` at ``imagenet_pytorch_horovod.py:203-230`` and
@@ -319,6 +329,8 @@ def build_eval_step(
 
     def step_fn(state, batch):
         inputs = batch.get("image", batch.get("input"))
+        if input_transform is not None:
+            inputs = input_transform(inputs)
         labels = batch["label"]
         extras = {k: batch[k] for k in EXTRA_INPUT_KEYS if k in batch}
         logits, _, _ = _forward(

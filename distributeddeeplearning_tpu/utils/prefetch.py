@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Iterator, Optional
+from typing import Iterator
 
 from distributeddeeplearning_tpu.parallel.sharding import shard_batch
 
@@ -34,7 +34,13 @@ def prefetch_to_device(
     batches: Iterator, mesh, *, size: int = 2
 ) -> Iterator:
     """Yield ``shard_batch(mesh, b)`` for each host batch ``b``, staged
-    ``size`` deep from a background thread."""
+    ``size`` deep from a background thread.
+
+    The worker runs AHEAD of the consumer: up to ``size`` staged batches
+    (plus one in flight) are pulled from ``batches`` beyond what has been
+    yielded, and are dropped on close.  Fine for the framework's own
+    restartable input_fns; callers handing in a shared or stateful iterator
+    should expect it to be consumed past the last yielded batch."""
     if size < 1:
         raise ValueError(f"prefetch size must be >= 1, got {size}")
     q: "queue.Queue" = queue.Queue(maxsize=size)

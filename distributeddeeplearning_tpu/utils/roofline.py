@@ -1,0 +1,188 @@
+"""Trace → roofline analysis: regenerable evidence for the perf story.
+
+VERDICT r03 #3: the README's HBM-roofline argument (75 GB/step, per-fusion
+GB/s, ~2,790 img/s ceiling) lived as prose that would silently rot.  This
+module recomputes every number in that analysis from a ``jax.profiler``
+trace, so ``bench.py --roofline`` can re-emit the whole table as JSON
+(``ROOFLINE_r{N}.json``) any round the step changes.
+
+Input: the chrome-trace export xprof writes under
+``<trace_dir>/plugins/profile/<run>/*.trace.json.gz``.  Device HLO events
+carry ``args`` with the XLA cost model's per-op ``bytes accessed`` and
+flops plus an ``hlo_category`` — aggregating those over a known number of
+steps gives HBM bytes/step and per-category/fusion sustained GB/s and
+TFLOP/s, which is exactly the data behind "the step is bandwidth-bound at
+88% of its ceiling".
+
+v5e nominals: 819 GB/s HBM, 394 TFLOP/s bf16 (``utils.hardware``).
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+# arg-key spellings seen across xprof versions
+_BYTES_KEYS = ("bytes accessed", "bytes_accessed", "raw_bytes_accessed")
+_FLOPS_KEYS = ("model flops", "model_flops", "flops")
+_CATEGORY_KEYS = ("hlo_category", "category")
+
+
+def find_trace_file(trace_dir: str) -> str:
+    """Newest ``*.trace.json.gz`` under ``trace_dir`` (xprof layout)."""
+    pattern = os.path.join(trace_dir, "**", "*.trace.json.gz")
+    candidates = glob.glob(pattern, recursive=True)
+    if not candidates:
+        raise FileNotFoundError(f"no *.trace.json.gz under {trace_dir}")
+    return max(candidates, key=os.path.getmtime)
+
+
+def _arg(args: Dict[str, Any], keys) -> Optional[float]:
+    for key in keys:
+        if key in args:
+            try:
+                return float(args[key])
+            except (TypeError, ValueError):
+                continue
+    return None
+
+
+def device_op_events(trace_file: str) -> List[Dict[str, Any]]:
+    """Complete ("X") events that look like device HLO ops: have a duration
+    and an XLA cost-model byte count in their args."""
+    opener = gzip.open if trace_file.endswith(".gz") else open
+    with opener(trace_file, "rt") as f:
+        trace = json.load(f)
+    out = []
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X" or not ev.get("dur"):
+            continue
+        args = ev.get("args") or {}
+        nbytes = _arg(args, _BYTES_KEYS)
+        if nbytes is None:
+            continue
+        category = None
+        for key in _CATEGORY_KEYS:
+            if args.get(key):
+                category = str(args[key])
+                break
+        out.append(
+            {
+                "name": ev.get("name", "?"),
+                "dur_us": float(ev["dur"]),
+                "bytes": nbytes,
+                "flops": _arg(args, _FLOPS_KEYS) or 0.0,
+                "category": category or "uncategorized",
+            }
+        )
+    return out
+
+
+def analyze_trace(
+    trace_dir: str,
+    *,
+    steps: int,
+    global_batch: Optional[int] = None,
+    peak_hbm_gbps: float = 819.0,
+    peak_tflops: float = 394.0,
+    bw_bound_threshold: float = 0.6,
+    top_n: int = 10,
+) -> Dict[str, Any]:
+    """Aggregate a ``steps``-step trace into the roofline verdict.
+
+    Returns a JSON-ready dict: total HBM GB/step, device ms/step, the
+    bandwidth-bound time fraction (ops sustaining more than
+    ``bw_bound_threshold`` of peak HBM), per-category rollup, top fusions
+    by time, the implied bandwidth-ceiling step time, and — with
+    ``global_batch`` — the implied ceiling in img/s.
+    """
+    events = device_op_events(find_trace_file(trace_dir))
+    if not events:
+        raise ValueError(f"no device HLO events with byte counts in {trace_dir}")
+
+    total_us = sum(e["dur_us"] for e in events)
+    total_bytes = sum(e["bytes"] for e in events)
+    total_flops = sum(e["flops"] for e in events)
+    bw_bound_us = 0.0
+    categories: Dict[str, Dict[str, float]] = {}
+    for e in events:
+        gbps = e["bytes"] / max(e["dur_us"], 1e-9) / 1e3  # B/us -> GB/s
+        if gbps >= bw_bound_threshold * peak_hbm_gbps:
+            bw_bound_us += e["dur_us"]
+        cat = categories.setdefault(
+            e["category"], {"us": 0.0, "bytes": 0.0, "flops": 0.0}
+        )
+        cat["us"] += e["dur_us"]
+        cat["bytes"] += e["bytes"]
+        cat["flops"] += e["flops"]
+
+    def _rate(bytes_, us):
+        return bytes_ / max(us, 1e-9) / 1e3
+
+    category_table = {
+        name: {
+            "time_ms_per_step": round(c["us"] / steps / 1e3, 3),
+            "time_fraction": round(c["us"] / total_us, 4),
+            "gb_per_step": round(c["bytes"] / steps / 1e9, 3),
+            "sustained_gbps": round(_rate(c["bytes"], c["us"]), 1),
+            "sustained_tflops": round(c["flops"] / max(c["us"], 1e-9) / 1e6, 2),
+        }
+        for name, c in sorted(
+            categories.items(), key=lambda kv: -kv[1]["us"]
+        )
+    }
+
+    fusion_totals: Dict[str, Dict[str, float]] = {}
+    for e in events:
+        f = fusion_totals.setdefault(
+            e["name"], {"us": 0.0, "bytes": 0.0, "flops": 0.0}
+        )
+        f["us"] += e["dur_us"]
+        f["bytes"] += e["bytes"]
+        f["flops"] += e["flops"]
+    top_fusions = [
+        {
+            "name": name[:80],
+            "time_ms_per_step": round(f["us"] / steps / 1e3, 3),
+            "sustained_gbps": round(_rate(f["bytes"], f["us"]), 1),
+            "sustained_tflops": round(f["flops"] / max(f["us"], 1e-9) / 1e6, 2),
+        }
+        for name, f in sorted(
+            fusion_totals.items(), key=lambda kv: -kv[1]["us"]
+        )[:top_n]
+    ]
+
+    bytes_per_step = total_bytes / steps
+    ceiling_ms = bytes_per_step / (peak_hbm_gbps * 1e9) * 1e3
+    measured_ms = total_us / steps / 1e3
+    result: Dict[str, Any] = {
+        "steps_analyzed": steps,
+        "device_ms_per_step": round(measured_ms, 2),
+        "hbm_gb_per_step": round(bytes_per_step / 1e9, 2),
+        "model_gflops_per_step": round(total_flops / steps / 1e9, 1),
+        "sustained_hbm_gbps": round(_rate(total_bytes, total_us), 1),
+        "sustained_tflops": round(total_flops / max(total_us, 1e-9) / 1e6, 2),
+        "peak_hbm_gbps": peak_hbm_gbps,
+        "peak_tflops": peak_tflops,
+        "bw_bound_time_fraction": round(bw_bound_us / total_us, 4),
+        "bandwidth_ceiling_ms_per_step": round(ceiling_ms, 2),
+        "pct_of_bandwidth_ceiling": round(ceiling_ms / measured_ms, 4),
+        "verdict": (
+            "hbm-bandwidth-bound"
+            if bw_bound_us / total_us > 0.5
+            else "compute-or-latency-bound"
+        ),
+        "categories": category_table,
+        "top_fusions": top_fusions,
+    }
+    if global_batch:
+        result["implied_ceiling_img_sec"] = round(
+            global_batch / (ceiling_ms / 1e3), 1
+        )
+        result["measured_img_sec_from_trace"] = round(
+            global_batch / (measured_ms / 1e3), 1
+        )
+    return result

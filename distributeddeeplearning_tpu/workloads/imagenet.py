@@ -44,13 +44,14 @@ def _batches(
     synthetic_length: Optional[int] = None,
     augment: str = "reference",
     input_pipeline: str = "tf",
+    start_batch: int = 0,
 ) -> Iterator:
-    if input_pipeline == "native" and data_format != "tfrecords":
+    if input_pipeline in ("native", "raw") and data_format != "tfrecords":
         raise ValueError(
-            "input_pipeline='native' supports data_format='tfrecords' only "
-            f"(got {data_format!r})"
+            f"input_pipeline={input_pipeline!r} supports "
+            f"data_format='tfrecords' only (got {data_format!r})"
         )
-    if input_pipeline not in ("tf", "native"):
+    if input_pipeline not in ("tf", "native", "raw"):
         raise ValueError(f"unknown input_pipeline {input_pipeline!r}")
     if data_format == "synthetic":
         import jax
@@ -81,6 +82,41 @@ def _batches(
             return epochs()
         return ds.batches(per_host_batch)
     if data_format == "tfrecords":
+        if input_pipeline == "raw":
+            # Decode-once uint8 cache (data/raw_cache.py) — the pipeline for
+            # decode-bound hosts (BENCH_DATA_r04: streaming decode feeds a
+            # v5e at 0.1-0.2x; the cache at 1.9x).  Pixels arrive uint8; the
+            # train/eval steps normalize ON DEVICE via input_transform (the
+            # caller wires uint8_normalizer when input_pipeline == 'raw').
+            if augment != "reference":
+                raise ValueError(
+                    "input_pipeline='raw' caches deterministically-"
+                    "preprocessed pixels; augment='reference' only"
+                )
+            import jax
+
+            from distributeddeeplearning_tpu.data.raw_cache import (
+                build_raw_cache,
+                cache_path_for,
+                raw_cache_input_fn,
+            )
+
+            cache_dir = cache_path_for(data_path, is_training, image_size)
+            if jax.process_count() > 1:
+                # Each host caches only its own shard-file slice.
+                build_raw_cache(
+                    data_path, cache_dir, is_training, image_size=image_size,
+                    shard_count=jax.process_count(),
+                    shard_index=jax.process_index(),
+                )
+            else:
+                build_raw_cache(
+                    data_path, cache_dir, is_training, image_size=image_size
+                )
+            return raw_cache_input_fn(
+                cache_dir, is_training, per_host_batch, seed=seed or 0,
+                repeat=is_training, start_batch=start_batch,
+            )
         if input_pipeline == "native":
             # The framework's own C reader + PIL/numpy path (TF-free);
             # implements the reference recipe only.
@@ -139,7 +175,8 @@ def main(
     compute_dtype: str = "bfloat16",
     distributed: Optional[bool] = None,
     augment: str = "reference",  # "inception" = stronger train-time aug
-    input_pipeline: str = "tf",  # "native" = the framework's C reader + PIL
+    input_pipeline: str = "tf",  # "native" = C reader+PIL; "raw" = u8 cache
+    checkpoint_every_steps: Optional[int] = None,  # mid-epoch save cadence
     profile_dir: Optional[str] = None,  # jax.profiler trace of steps 10-20
     metrics_path: Optional[str] = None,  # per-epoch JSONL rows (run.log_row)
     aux_logits: bool = False,  # InceptionV3 aux head, loss weighted 0.4
@@ -200,18 +237,39 @@ def main(
         jax.random.key(seed), net, (1, image_size, image_size, 3), tx
     )
     step_kwargs = {"loss_fn": loss_fn} if loss_fn is not None else {}
+    if input_pipeline == "raw":
+        # raw-cache batches are uint8; cast + channel-mean subtraction move
+        # on-device (fused by XLA into the first conv's input chain).
+        from distributeddeeplearning_tpu.data.raw_cache import uint8_normalizer
+
+        step_kwargs["input_transform"] = uint8_normalizer()
     train_step = build_train_step(
         mesh, state, schedule=schedule, label_smoothing=label_smoothing,
         compute_dtype=dtype, rng=jax.random.key(seed + 1),
         accum_steps=accum_steps, **step_kwargs,
     )
-    eval_step = build_eval_step(mesh, state, compute_dtype=dtype)
-
-    train_iter = _batches(
-        data_format, training_data_path, True, per_host_batch,
-        image_size, num_classes, seed, synthetic_length=n_train,
-        augment=augment, input_pipeline=input_pipeline,
+    eval_step = build_eval_step(
+        mesh, state, compute_dtype=dtype,
+        input_transform=step_kwargs.get("input_transform"),
     )
+
+    if input_pipeline == "raw":
+        # Step-indexed factory: Trainer.fit resumes by asking for the stream
+        # from the restored step, and the raw cache fast-forwards at index-
+        # math cost — replay-free exact resume (train/loop.py fit docstring).
+        def train_iter(start_step: int):
+            return _batches(
+                data_format, training_data_path, True, per_host_batch,
+                image_size, num_classes, seed, synthetic_length=n_train,
+                augment=augment, input_pipeline=input_pipeline,
+                start_batch=start_step,
+            )
+    else:
+        train_iter = _batches(
+            data_format, training_data_path, True, per_host_batch,
+            image_size, num_classes, seed, synthetic_length=n_train,
+            augment=augment, input_pipeline=input_pipeline,
+        )
     eval_factory = None
     if validation_data_path or data_format == "synthetic":
         def eval_factory():
@@ -231,6 +289,7 @@ def main(
             steps_per_epoch=spe,
             global_batch_size=global_batch,
             checkpoint_dir=save_filepath,
+            checkpoint_every_steps=checkpoint_every_steps,
             tensorboard_dir=tensorboard_dir,
             resume=resume,
             profile_dir=profile_dir,

@@ -77,6 +77,7 @@ def main(
     resume: bool = True,
     profile_dir: Optional[str] = None,
     metrics_path: Optional[str] = None,
+    checkpoint_every_steps: Optional[int] = None,  # mid-epoch save cadence
     seed: int = 42,
     compute_dtype: str = "bfloat16",
     distributed: Optional[bool] = None,
@@ -86,6 +87,7 @@ def main(
     num_slices: int = 1,  # multi-slice (DCN) data parallelism
     num_microbatches: int = 8,
     remat: bool = False,  # jax.checkpoint each pipeline tick (ops/pipeline.py)
+    attention: str = "dense",  # "flash" = causal Pallas kernel (long context)
 ):
     """Train; returns (state, FitResult)."""
     import jax
@@ -166,9 +168,11 @@ def main(
             logits = forward_pipelined(
                 p, tokens, num_heads=num_heads, mesh=mesh,
                 num_microbatches=num_microbatches, remat=remat,
+                attention=attention,
             )
         else:
-            logits = forward(p, tokens, num_heads=num_heads)
+            logits = forward(p, tokens, num_heads=num_heads,
+                             attention=attention)
         logits = logits.astype(jnp.float32)
         if mutable is not None:
             return logits, {}
@@ -252,6 +256,7 @@ def main(
             resume=resume,
             profile_dir=profile_dir,
             metrics_path=metrics_path,
+            checkpoint_every_steps=checkpoint_every_steps,
         ),
     )
     return trainer.fit(state, train_iter, eval_factory)

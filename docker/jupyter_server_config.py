@@ -1,0 +1,9 @@
+# Jupyter config for the control-plane image — role parity with the
+# reference's control/Docker/jupyter_notebook_config.py (listen on all
+# interfaces inside the container, fixed port mapped by `make docker-run`,
+# no browser).  Written for the modern jupyter-server config surface.
+c.ServerApp.ip = "0.0.0.0"  # noqa: F821 — `c` is injected by jupyter
+c.ServerApp.port = 9999  # noqa: F821
+c.ServerApp.open_browser = False  # noqa: F821
+c.ServerApp.allow_root = True  # noqa: F821 — the container runs as root
+c.ServerApp.root_dir = "/workspace"  # noqa: F821

@@ -298,3 +298,59 @@ def test_runs_status_filter(project, capsys):
 
     assert main(["runs", "--status", "failed"]) == 0
     assert "no failed runs" in capsys.readouterr().out
+
+
+class TestCompletionAndRepl:
+    def test_completion_bash_covers_verb_tree(self, capsys):
+        assert main(["completion", "bash"]) == 0
+        script = capsys.readouterr().out
+        # every top-level verb present, generated from the live parser
+        for verb in ("setup", "tpu", "storage", "runs", "imagenet",
+                     "interactive", "completion", "tensorboard"):
+            assert verb in script
+        # nested verbs and flags are baked in
+        assert "prepare-imagenet" in script
+        assert "val-maps" in script
+        assert "--dry-run" in script
+        assert "complete -F _ddlt_complete ddlt" in script
+
+    def test_completion_bash_is_valid_shell(self, capsys, tmp_path):
+        import subprocess
+
+        main(["completion", "bash"])
+        script = tmp_path / "c.sh"
+        script.write_text(capsys.readouterr().out)
+        assert subprocess.run(["bash", "-n", str(script)]).returncode == 0
+
+    def test_completion_zsh_wraps_bashcompinit(self, capsys):
+        assert main(["completion", "zsh"]) == 0
+        out = capsys.readouterr().out
+        assert "bashcompinit" in out
+
+    def test_interactive_repl_preloads_sdk(self, tmp_path, monkeypatch):
+        """--repl hands cfg/pod/submitter/registry to the REPL namespace
+        (tasks.py:84-87 parity) instead of SSHing to a worker."""
+        env = tmp_path / ".env"
+        env.write_text(
+            "GCS_BUCKET=b\nTPU_NAME=pod-x\nTPU_TYPE=v5litepod-16\n"
+            "GCP_ZONE=us-west4-a\n"
+        )
+        captured = {}
+
+        def fake_ipython(argv, user_ns, config=None):
+            captured.update(user_ns)
+            # banner text must travel via the traitlets config (the real
+            # start_ipython rejects a string display_banner)
+            assert "ddlt interactive REPL" in (
+                config.TerminalInteractiveShell.banner1
+            )
+
+        import distributeddeeplearning_tpu.cli.main as cli_main
+
+        monkeypatch.setitem(
+            __import__("sys").modules, "IPython",
+            type("M", (), {"start_ipython": staticmethod(fake_ipython)}),
+        )
+        assert main(["--env-file", str(env), "interactive", "--repl"]) == 0
+        assert {"cfg", "runner", "registry", "pod", "submitter"} <= set(captured)
+        assert captured["pod"].name == "pod-x"

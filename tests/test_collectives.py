@@ -1,0 +1,307 @@
+"""Compiled-HLO collective signatures for every sharded mode.
+
+VERDICT r03 #4: the multichip dryrun proves each sharded train step runs and
+its loss decreases, but says nothing about the communication XLA actually
+inserted — a sharding regression that silently replicates params (or
+all-gathers activations every layer) still produces finite, decreasing loss
+while multiplying ICI traffic.  These tests lower each mode's train step on
+the 8-device CPU mesh (the partitioner is platform-independent), read the
+compiled module's HLO, and pin the expected collective signature:
+
+  DP          grad all-reduce(s) carrying >= the model's parameter bytes;
+              no all-gather / reduce-scatter / all-to-all
+  FSDP        param all-gather(s) in fwd/bwd + grad reduce-scatter(s)
+  TP          activation all-reduces (row-parallel matmul outputs)
+  ring SP     collective-permute k/v rotation (inside the scan while-loop)
+  Ulysses SP  all-to-all head<->sequence re-sharding
+  MoE EP      all-to-all expert dispatch/combine
+  pipeline    collective-permute stage rotation
+
+This is the strongest multi-chip evidence obtainable without hardware: the
+communication *pattern* is compile-time; only its wall-clock cost needs real
+ICI.  Complements ``__graft_entry__.dryrun_multichip`` (execution) and
+``MULTICHIP_r*.json``.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributeddeeplearning_tpu.models import get_model
+from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh, shard_batch
+from distributeddeeplearning_tpu.parallel.sharding import (
+    RULES_EP,
+    RULES_FSDP,
+    RULES_TP,
+    model_logical_axes,
+)
+from distributeddeeplearning_tpu.train.state import create_train_state
+from distributeddeeplearning_tpu.train.step import build_train_step
+
+# ---------------------------------------------------------------------------
+# HLO inspection helpers
+# ---------------------------------------------------------------------------
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
+    "s64": 8, "s32": 4, "s16": 2, "s8": 1, "u64": 8, "u32": 4,
+    "u16": 2, "u8": 1, "pred": 1,
+}
+
+
+def compiled_hlo(step, state, batch) -> str:
+    return step.lower(state, batch).compile().as_text()
+
+
+def _shape_bytes(shape: str) -> int:
+    """Bytes of one HLO shape literal like ``f32[128,1001]`` or ``bf16[]``."""
+    m = re.match(r"([a-z0-9]+)\[([\d,]*)\]", shape)
+    if not m:
+        return 0
+    dtype, dims = m.groups()
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def collective_ops(hlo: str, name: str):
+    """All occurrences of a collective op with their result shapes.
+
+    Matches both plain results (``f32[...] all-reduce(...)``) and tuple
+    results (``(f32[...], f32[...]) all-reduce-start(...)``); returns a list
+    of per-op byte counts.
+    """
+    out = []
+    # op applications are " = <shape> opname(" in HLO text
+    for m in re.finditer(
+        rf"= (\([^)]*\)|[a-z0-9]+\[[\d,]*\](?:\{{[^}}]*\}})?) {name}[.\d]*\(",
+        hlo,
+    ):
+        shapes = re.findall(r"[a-z0-9]+\[[\d,]*\]", m.group(1))
+        out.append(sum(_shape_bytes(s) for s in shapes))
+    return out
+
+
+def param_bytes(state) -> int:
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(state.params)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Mode builders (tiny shapes; mirror __graft_entry__.dryrun_multichip legs)
+# ---------------------------------------------------------------------------
+
+N_DEV = 8
+
+
+def _resnet_leg(rules, mesh_spec):
+    mesh = create_mesh(mesh_spec, devices=jax.devices()[:N_DEV])
+    model = get_model("resnet18", num_classes=101, dtype=jnp.float32)
+    tx = optax.sgd(0.1)
+    state = create_train_state(jax.random.key(0), model, (2, 32, 32, 3), tx)
+    step = build_train_step(
+        mesh, state, compute_dtype=jnp.float32, rules=rules
+    )
+    rng = np.random.default_rng(0)
+    batch = shard_batch(
+        mesh,
+        {
+            "image": rng.standard_normal((2 * N_DEV, 32, 32, 3)).astype(
+                np.float32
+            ),
+            "label": rng.integers(0, 101, (2 * N_DEV,)).astype(np.int32),
+        },
+    )
+    return step, state, batch, mesh
+
+
+def _bert_leg(mesh_spec, rules, *, attention_fn=None, num_experts=None,
+              batch_rows=None):
+    mesh = create_mesh(mesh_spec, devices=jax.devices()[:N_DEV])
+    kwargs = dict(
+        num_layers=2, hidden_size=64, num_heads=4, intermediate_size=128,
+        vocab_size=211, num_classes=5, max_position_embeddings=32,
+        dropout_rate=0.0, dtype=jnp.float32,
+    )
+    if attention_fn is not None:
+        kwargs["attention_fn"] = attention_fn
+    if num_experts is not None:
+        kwargs["num_experts"] = num_experts
+    model = get_model("bert-base", **kwargs)
+    rows = batch_rows if batch_rows is not None else 2 * N_DEV
+    tx = optax.sgd(0.1)
+    axes = model_logical_axes(
+        model, jax.random.key(0), np.zeros((rows, 16), np.int32), train=False
+    )
+    state = create_train_state(
+        jax.random.key(0), model, (rows, 16), tx, input_dtype=jnp.int32
+    )
+    step = build_train_step(
+        mesh, state, compute_dtype=jnp.float32, rules=rules, logical_axes=axes
+    )
+    rng = np.random.default_rng(0)
+    batch = shard_batch(
+        mesh,
+        {
+            "input": rng.integers(0, 211, (rows, 16)).astype(np.int32),
+            "label": rng.integers(0, 5, (rows,)).astype(np.int32),
+        },
+    )
+    return step, state, batch, mesh
+
+
+# ---------------------------------------------------------------------------
+# Signatures
+# ---------------------------------------------------------------------------
+
+
+def test_dp_emits_grad_allreduce_and_nothing_else():
+    """Pure DP = Horovod semantics: the ONLY communication is the gradient
+    (+metrics) all-reduce.  Its payload must cover every parameter byte —
+    fewer means some grads never synchronized."""
+    step, state, batch, _ = _resnet_leg([], MeshSpec())
+    hlo = compiled_hlo(step, state, batch)
+    ar = collective_ops(hlo, "all-reduce") + collective_ops(
+        hlo, "all-reduce-start"
+    )
+    assert ar, "DP step compiled without any all-reduce"
+    assert sum(ar) >= param_bytes(state), (
+        f"all-reduce payload {sum(ar)} < param bytes {param_bytes(state)}"
+    )
+    # The partitioner may gather metric-sized tensors (e.g. the [B, classes]
+    # logits, ~6KB) to compute replicated scalars — fine.  A PARAMETER-scale
+    # all-gather would mean params were actually sharded: that is the
+    # regression this test exists to catch.
+    big_gathers = [
+        b for b in collective_ops(hlo, "all-gather")
+        if b > 0.01 * param_bytes(state)
+    ]
+    assert not big_gathers, (
+        f"parameter-scale all-gather in DP step: {big_gathers} bytes"
+    )
+    assert not collective_ops(hlo, "reduce-scatter"), (
+        "unexpected reduce-scatter in DP"
+    )
+    assert not collective_ops(hlo, "all-to-all"), "unexpected all-to-all in DP"
+
+
+def test_fsdp_emits_allgather_and_sharded_grad_reduction():
+    """ZeRO-3 layout: forward/backward all-gather the sharded params, and
+    the gradient reduction keeps only each shard's slice.  The TPU backend
+    emits that as ``reduce-scatter``; the CPU partitioner (this test's
+    backend) lowers the SAME pattern as all-reduce + dynamic-slice — accept
+    either spelling, require the pattern."""
+    step, state, batch, _ = _resnet_leg(RULES_FSDP, MeshSpec(fsdp=N_DEV))
+    hlo = compiled_hlo(step, state, batch)
+    ag = collective_ops(hlo, "all-gather") + collective_ops(
+        hlo, "all-gather-start"
+    )
+    assert ag, "FSDP step compiled without param all-gathers"
+    rs = collective_ops(hlo, "reduce-scatter")
+    ar = collective_ops(hlo, "all-reduce") + collective_ops(
+        hlo, "all-reduce-start"
+    )
+    ds = collective_ops(hlo, "dynamic-slice")
+    assert rs or (ar and ds), (
+        "FSDP step compiled without a sharded gradient reduction "
+        "(neither reduce-scatter nor all-reduce+dynamic-slice)"
+    )
+
+
+def test_tp_emits_activation_allreduces():
+    """Megatron row-parallel outputs all-reduce activations per layer (fwd)
+    and per layer again in bwd — strictly more all-reduce SITES than pure
+    DP's single fused grad reduction."""
+    step, state, batch, _ = _bert_leg(MeshSpec(tensor=N_DEV), RULES_TP)
+    hlo = compiled_hlo(step, state, batch)
+    ar = collective_ops(hlo, "all-reduce") + collective_ops(
+        hlo, "all-reduce-start"
+    )
+    assert len(ar) >= 2, f"TP step emitted {len(ar)} all-reduce sites"
+
+
+def test_ring_attention_emits_collective_permutes():
+    """Ring SP rotates k/v via ppermute inside the scan loop."""
+    from distributeddeeplearning_tpu.ops import make_ring_attention
+
+    mesh = create_mesh(MeshSpec(seq=2), devices=jax.devices()[:N_DEV])
+    step, state, batch, _ = _bert_leg(
+        MeshSpec(seq=2), [],
+        attention_fn=make_ring_attention(mesh), batch_rows=2 * (N_DEV // 2),
+    )
+    hlo = compiled_hlo(step, state, batch)
+    cp = collective_ops(hlo, "collective-permute") + collective_ops(
+        hlo, "collective-permute-start"
+    )
+    assert cp, "ring attention compiled without collective-permute"
+
+
+def test_ulysses_emits_all_to_all():
+    from distributeddeeplearning_tpu.ops import make_ulysses_attention
+
+    mesh = create_mesh(MeshSpec(seq=2), devices=jax.devices()[:N_DEV])
+    step, state, batch, _ = _bert_leg(
+        MeshSpec(seq=2), [],
+        attention_fn=make_ulysses_attention(mesh),
+        batch_rows=2 * (N_DEV // 2),
+    )
+    hlo = compiled_hlo(step, state, batch)
+    assert collective_ops(hlo, "all-to-all"), (
+        "Ulysses attention compiled without all-to-all"
+    )
+
+
+def test_moe_expert_sharding_emits_cross_expert_collectives():
+    """The MoE layer is GShard/Switch DENSE dispatch (one-hot einsums,
+    ``models/moe.py``), so expert parallelism deliberately lowers to
+    gather/reduce collectives over the ``expert`` axis rather than the
+    gather-scatter all-to-all of token-routing implementations — assert
+    that signature: all-gathers (expert-sharded weights / token resharding)
+    plus strictly more all-reduce sites than the pure-DP single fused grad
+    reduction.  (Explicit a2a coverage is Ulysses' test above.)"""
+    step, state, batch, _ = _bert_leg(
+        MeshSpec(expert=2), list(RULES_TP) + list(RULES_EP), num_experts=2,
+        batch_rows=2 * (N_DEV // 2),
+    )
+    hlo = compiled_hlo(step, state, batch)
+    ag = collective_ops(hlo, "all-gather")
+    ar = collective_ops(hlo, "all-reduce") + collective_ops(
+        hlo, "all-reduce-start"
+    )
+    assert ag, "expert-parallel MoE compiled without all-gathers"
+    assert len(ar) >= 2, (
+        f"expert-parallel MoE emitted only {len(ar)} all-reduce sites"
+    )
+
+
+def test_pipeline_emits_collective_permutes():
+    """GPipe stage rotation moves microbatch activations with ppermute."""
+    from distributeddeeplearning_tpu.ops.pipeline import pipeline_apply
+
+    mesh = create_mesh(MeshSpec(pipe=2), devices=jax.devices()[:N_DEV])
+    rng = np.random.default_rng(1)
+    params = {
+        "w": jnp.asarray(rng.standard_normal((2, 8, 8)), jnp.float32),
+        "b": jnp.asarray(rng.standard_normal((2, 8)), jnp.float32),
+    }
+    x = jnp.asarray(rng.standard_normal((2 * N_DEV, 8)), jnp.float32)
+
+    def stage(p, h):
+        return h + jnp.tanh(h @ p["w"] + p["b"])
+
+    fn = jax.jit(
+        lambda p, h: pipeline_apply(stage, p, h, mesh=mesh, num_microbatches=2)
+    )
+    hlo = fn.lower(params, x).compile().as_text()
+    cp = collective_ops(hlo, "collective-permute") + collective_ops(
+        hlo, "collective-permute-start"
+    )
+    assert cp, "pipeline compiled without collective-permute rotation"

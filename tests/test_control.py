@@ -569,7 +569,9 @@ class TestStreamingAndPoll:
         runner.run(["sh", "-c", "echo second"], stream_to=str(log))
         assert log.read_text() == "first\nsecond\n"
 
-    def _poll_runner(self, pod_state="READY", probe="DEAD"):
+    def _poll_runner(self, pod_state="READY", probe="DEAD\nDEAD"):
+        # submit_env's TPU_TYPE=v5litepod-16 is a 2-host pod; the probe
+        # fans out --worker=all, so a full answer is one line per host.
         def describe(argv):
             return "describe" in argv
 
@@ -596,19 +598,47 @@ class TestStreamingAndPoll:
 
     def test_poll_flips_stranded_run_to_failed(self, submit_env):
         cfg, _, registry = submit_env
-        runner = self._poll_runner(probe="DEAD")
+        runner = self._poll_runner(probe="DEAD\nDEAD")
         run = self._stranded_run(cfg, registry)
         polled = Submitter(cfg, runner, registry).poll_run("exp1", run.run_id)
         assert polled.status == "failed"
-        assert "no launcher process" in polled.extra["poll"]
+        assert "no launcher process on 2/2 workers" in polled.extra["poll"]
         assert registry.find("exp1", run.run_id).status == "failed"
+        # The probe must fan out to every worker, not just worker 0.
+        probe_argv = next(
+            a for a in runner.history
+            if "--command" in a and "pgrep" in a[a.index("--command") + 1]
+        )
+        assert probe_argv[probe_argv.index("--worker") + 1] == "all"
 
     def test_poll_keeps_live_run_running(self, submit_env):
         cfg, _, registry = submit_env
-        runner = self._poll_runner(probe="ALIVE")
+        runner = self._poll_runner(probe="ALIVE\nALIVE")
         run = self._stranded_run(cfg, registry)
         polled = Submitter(cfg, runner, registry).poll_run("exp1", run.run_id)
         assert polled.status == "running"
+
+    def test_poll_any_live_worker_outvotes_dead_ones(self, submit_env):
+        """A dead worker-0 launcher with a live peer must NOT fail the run —
+        the pre-quorum poll decided from worker 0 alone (VERDICT r03 #7)."""
+        cfg, _, registry = submit_env
+        runner = self._poll_runner(probe="DEAD\nALIVE")
+        run = self._stranded_run(cfg, registry)
+        polled = Submitter(cfg, runner, registry).poll_run("exp1", run.run_id)
+        assert polled.status == "running"
+        assert polled.extra["poll_workers"] == {
+            "alive": 1, "dead": 1, "expected": 2,
+        }
+
+    def test_poll_dead_minority_is_inconclusive(self, submit_env):
+        """One DEAD answer from a 2-host pod (other worker unreachable) is
+        not a quorum — a half-blind probe must not condemn the run."""
+        cfg, _, registry = submit_env
+        runner = self._poll_runner(probe="DEAD")
+        run = self._stranded_run(cfg, registry)
+        polled = Submitter(cfg, runner, registry).poll_run("exp1", run.run_id)
+        assert polled.status == "running"
+        assert polled.extra["poll_workers"]["dead"] == 1
 
     def test_poll_fails_run_when_pod_gone(self, submit_env):
         cfg, _, registry = submit_env

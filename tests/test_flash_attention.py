@@ -184,3 +184,136 @@ def test_bf16_gradients_finite():
     for g in grads:
         assert g.dtype == jnp.bfloat16
         assert np.isfinite(np.asarray(g, np.float32)).all()
+
+
+# ---------------------------------------------------------------------------
+# Causal mode (VERDICT r03 #2): in-kernel triangle mask + block skip, exact
+# against a dense causal oracle in forward and all three gradients, alone
+# and combined with key padding.
+# ---------------------------------------------------------------------------
+
+
+def _dense_causal(q, k, v, mask):
+    """Dense causal oracle (the pipelined_transformer block's math)."""
+    b, s, h, d = q.shape
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(d, jnp.float32)
+    )
+    if mask is not None:
+        scores = jnp.where(
+            jnp.broadcast_to(mask, (b, 1, 1, s)), scores, -1e30
+        )
+    tri = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(tri[None, None], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("block", [16, 32, 64])
+def test_causal_forward_matches_dense(block):
+    q, k, v, _ = _inputs(3)
+    got = flash_attention(
+        q, k, v, None, dtype=jnp.float32, block_q=block, block_k=block,
+        causal=True,
+    )
+    want = _dense_causal(q, k, v, None)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_causal_asymmetric_blocks():
+    q, k, v, _ = _inputs(4)
+    got = flash_attention(
+        q, k, v, None, dtype=jnp.float32, block_q=16, block_k=32, causal=True
+    )
+    want = _dense_causal(q, k, v, None)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+    got = flash_attention(
+        q, k, v, None, dtype=jnp.float32, block_q=32, block_k=16, causal=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_causal_with_padding_mask():
+    q, k, v, mask = _inputs(5)
+    got = flash_attention(
+        q, k, v, mask, dtype=jnp.float32, block_q=16, block_k=16, causal=True
+    )
+    want = _dense_causal(q, k, v, mask)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_causal_gradients_match_dense():
+    q, k, v, mask = _inputs(6)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(
+            q, k, v, mask, dtype=jnp.float32, block_q=16, block_k=16,
+            causal=True,
+        )
+        return (o ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_dense_causal(q, k, v, mask) ** 2).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, rtol=5e-4
+        )
+
+
+def test_causal_first_row_attends_only_itself():
+    """Query 0 may see only key 0 — its output must equal v[0] exactly."""
+    q, k, v, _ = _inputs(7)
+    got = flash_attention(
+        q, k, v, None, dtype=jnp.float32, block_q=16, block_k=16, causal=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got[:, 0]), np.asarray(v[:, 0]), atol=1e-6
+    )
+
+
+def test_pipelined_transformer_flash_matches_dense():
+    """The decoder model's attention="flash" path reproduces the dense path
+    (logits and parameter gradients) — the VERDICT's 'wired into the decoder'
+    requirement, checked end-to-end through forward()."""
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        forward,
+        init_params,
+        next_token_loss,
+    )
+
+    params = init_params(
+        jax.random.key(0), num_layers=2, d_model=64, num_heads=4, d_ff=128,
+        vocab_size=97, max_len=32,
+    )
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, 97, (2, 32)), jnp.int32
+    )
+    lg_dense = forward(params, toks, num_heads=4, attention="dense")
+    lg_flash = forward(params, toks, num_heads=4, attention="flash")
+    np.testing.assert_allclose(
+        np.asarray(lg_flash), np.asarray(lg_dense), atol=2e-4, rtol=2e-4
+    )
+
+    def loss(p, attention):
+        return next_token_loss(
+            forward(p, toks, num_heads=4, attention=attention), toks
+        )
+
+    g_dense = jax.grad(lambda p: loss(p, "dense"))(params)
+    g_flash = jax.grad(lambda p: loss(p, "flash"))(params)
+    flat_d, _ = jax.flatten_util.ravel_pytree(g_dense)
+    flat_f, _ = jax.flatten_util.ravel_pytree(g_flash)
+    np.testing.assert_allclose(
+        np.asarray(flat_f), np.asarray(flat_d), atol=5e-4, rtol=5e-4
+    )

@@ -124,3 +124,110 @@ def test_steps_per_epoch_world_scaling():
     world = 32
     steps = total_images // (batch_per_chip * world)
     assert steps == total_images // batch_per_chip // world
+
+
+def test_drain_bounded_guards_eval_buffer():
+    """The multi-host eval drain must refuse to buffer past the cap (an
+    oversized eval split fails loudly instead of swapping the host), honor
+    the eval_steps limit, and pass small drains through untouched."""
+    from distributeddeeplearning_tpu.train.loop import _drain_bounded
+
+    assert _drain_bounded(iter(range(5)), None, 10) == [0, 1, 2, 3, 4]
+    assert _drain_bounded(iter(range(5)), 3, 10) == [0, 1, 2]
+    # limit wins over cap when it stops the drain first
+    assert _drain_bounded(iter(range(100)), 4, 4) == [0, 1, 2, 3]
+    with pytest.raises(RuntimeError, match="eval_buffer_batches"):
+        _drain_bounded(iter(range(100)), None, 8)
+    with pytest.raises(RuntimeError, match="eval_buffer_batches"):
+        _drain_bounded(iter(range(100)), 50, 8)
+
+
+def _step_indexed_factory(start_step: int):
+    """Deterministic step-indexed batch stream: batch for true step i is a
+    pure function of i — the replay-free resume contract."""
+
+    def batches():
+        i = start_step
+        while True:
+            rng = np.random.default_rng(1000 + i)
+            yield {
+                "image": rng.standard_normal((GLOBAL_BATCH, *IMG)).astype(
+                    np.float32
+                ),
+                "label": rng.integers(0, NCLS, (GLOBAL_BATCH,)).astype(
+                    np.int32
+                ),
+            }
+            i += 1
+
+    return batches()
+
+
+def test_midepoch_resume_bit_identical(parts, tmp_path):
+    """Kill at step k, resume, finish — the final state must equal the
+    uninterrupted run's bit for bit (VERDICT r03 #5).  checkpoint_every_steps
+    saves inside the epoch; resume lands on the exact step and the
+    step-indexed factory hands back the stream from there, so no batch
+    repeats and none is skipped."""
+    mesh, mk_state, train_step, eval_step = parts
+
+    # Uninterrupted reference: 2 epochs x 5 steps.
+    cfg_ref = TrainerConfig(
+        epochs=2, steps_per_epoch=5, global_batch_size=GLOBAL_BATCH,
+        prefetch=0,
+    )
+    ref_state, _ = Trainer(mesh, train_step, config=cfg_ref).fit(
+        mk_state(), _step_indexed_factory
+    )
+
+    # Interrupted run: same config + step-interval checkpoints; the data
+    # stream dies after 7 batches (mid-epoch-2 "preemption").
+    ckpt = str(tmp_path / "mid_ckpt")
+    cfg = TrainerConfig(
+        epochs=2, steps_per_epoch=5, global_batch_size=GLOBAL_BATCH,
+        checkpoint_dir=ckpt, checkpoint_every_steps=3, prefetch=0,
+    )
+
+    def dying_factory(start_step: int):
+        return itertools.islice(_step_indexed_factory(start_step), 7)
+
+    with pytest.raises(StopIteration):
+        Trainer(mesh, train_step, config=cfg).fit(mk_state(), dying_factory)
+    # steps 3 and 6 were checkpointed before the crash at step 8
+    assert Trainer(
+        mesh, train_step, config=cfg
+    ).checkpointer.latest_step() == 6
+
+    # Resume: restores step 6, asks the factory for the stream from step 6,
+    # runs steps 7..10.
+    resumed_state, result = Trainer(mesh, train_step, config=cfg).fit(
+        mk_state(), _step_indexed_factory
+    )
+    assert int(resumed_state.step) == 10
+    ref_flat, _ = jax.flatten_util.ravel_pytree(
+        {"p": ref_state.params, "o": ref_state.opt_state,
+         "b": ref_state.batch_stats}
+    )
+    res_flat, _ = jax.flatten_util.ravel_pytree(
+        {"p": resumed_state.params, "o": resumed_state.opt_state,
+         "b": resumed_state.batch_stats}
+    )
+    np.testing.assert_array_equal(np.asarray(ref_flat), np.asarray(res_flat))
+    # the resumed run executed only the 4 remaining steps (7..10)
+    assert result.total_images == 4 * GLOBAL_BATCH
+
+
+def test_step_checkpoint_cadence(parts, tmp_path):
+    """checkpoint_every_steps saves on true-step boundaries across epochs."""
+    mesh, mk_state, train_step, _ = parts
+    ckpt = str(tmp_path / "cadence")
+    cfg = TrainerConfig(
+        epochs=2, steps_per_epoch=3, global_batch_size=GLOBAL_BATCH,
+        checkpoint_dir=ckpt, checkpoint_every_steps=2, prefetch=0,
+    )
+    trainer = Trainer(mesh, train_step, config=cfg)
+    trainer.fit(mk_state(), _step_indexed_factory)
+    trainer.checkpointer.wait()
+    steps = set(trainer.checkpointer._mgr.all_steps())
+    # every-2 saves at 2,4,6 plus epoch-end saves at 3,6
+    assert {2, 3, 4, 6}.issubset(steps)

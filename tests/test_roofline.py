@@ -1,0 +1,115 @@
+"""Roofline trace analysis (utils/roofline.py).
+
+Runs the full aggregation on a miniature trace written in the xprof
+chrome-trace schema (gzip ``*.trace.json.gz``, device HLO events carrying
+``bytes accessed`` / ``model flops`` / ``hlo_category`` args — the layout
+validated against real v5e traces in round 3/4).  Numbers below are chosen
+so every derived quantity is hand-checkable.
+"""
+
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.utils.roofline import (
+    analyze_trace,
+    device_op_events,
+    find_trace_file,
+)
+
+
+def _write_trace(trace_dir: str, events):
+    d = os.path.join(trace_dir, "plugins", "profile", "run1")
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, "host.trace.json.gz")
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+def _dev_event(name, dur_us, nbytes, flops, category):
+    return {
+        "ph": "X", "name": name, "ts": 0, "dur": dur_us, "pid": 1, "tid": 1,
+        "args": {
+            "bytes accessed": str(nbytes),
+            "model flops": str(flops),
+            "hlo_category": category,
+        },
+    }
+
+
+@pytest.fixture()
+def mini_trace(tmp_path):
+    """2 traced steps: per step one conv fusion at exactly 800 GB/s
+    (80 MB / 100 us) and one copy at 100 GB/s (1 MB / 10 us)."""
+    events = []
+    for _ in range(2):
+        events.append(
+            _dev_event("fusion.1", 100.0, 80_000_000, 5_000_000_000,
+                       "convolution fusion")
+        )
+        events.append(_dev_event("copy.1", 10.0, 1_000_000, 0, "copy"))
+    # host noise the parser must ignore: no byte args / wrong phase
+    events.append({"ph": "X", "name": "hostThing", "ts": 0, "dur": 50,
+                   "pid": 9, "tid": 9, "args": {}})
+    events.append({"ph": "M", "name": "meta", "pid": 1, "args": {}})
+    _write_trace(str(tmp_path), events)
+    return str(tmp_path)
+
+
+def test_event_filtering(mini_trace):
+    events = device_op_events(find_trace_file(mini_trace))
+    assert len(events) == 4  # host noise dropped
+    assert {e["category"] for e in events} == {"convolution fusion", "copy"}
+
+
+def test_aggregation_hand_checked(mini_trace):
+    r = analyze_trace(
+        mini_trace, steps=2, global_batch=256,
+        peak_hbm_gbps=819.0, peak_tflops=394.0,
+    )
+    # per step: 81 MB, 110 us (gb field rounds to 2 decimals)
+    assert r["hbm_gb_per_step"] == pytest.approx(0.08, abs=0.006)
+    assert r["device_ms_per_step"] == pytest.approx(0.11)
+    assert r["model_gflops_per_step"] == pytest.approx(5.0)
+    # conv at 800 GB/s >= 0.6*819 -> bandwidth-bound; copy at 100 GB/s not
+    assert r["bw_bound_time_fraction"] == pytest.approx(100 / 110, abs=1e-3)
+    assert r["verdict"] == "hbm-bandwidth-bound"
+    # ceiling: 81 MB / 819 GB/s = 98.9 us -> vs 110 us measured (the ms
+    # fields round to 2 decimals — coarse at mini-trace scale, fine at the
+    # real ~95 ms scale; the ratio fields carry the precision)
+    assert r["bandwidth_ceiling_ms_per_step"] == pytest.approx(0.0989, abs=0.01)
+    assert r["pct_of_bandwidth_ceiling"] == pytest.approx(0.0989 / 0.11, abs=1e-2)
+    assert r["implied_ceiling_img_sec"] == pytest.approx(
+        256 / 0.0989e-3, rel=0.02
+    )
+    cat = r["categories"]["convolution fusion"]
+    assert cat["sustained_gbps"] == pytest.approx(800.0)
+    assert cat["time_fraction"] == pytest.approx(100 / 110, abs=1e-3)
+    assert r["top_fusions"][0]["name"] == "fusion.1"
+
+
+def test_alternate_arg_spellings(tmp_path):
+    events = [{
+        "ph": "X", "name": "f", "ts": 0, "dur": 10.0, "pid": 1, "tid": 1,
+        "args": {"bytes_accessed": 50_000_000, "flops": 500,
+                 "category": "fusion"},
+    }]
+    _write_trace(str(tmp_path), events)
+    r = analyze_trace(str(tmp_path), steps=1)
+    assert r["hbm_gb_per_step"] == pytest.approx(0.05, abs=0.006)
+    assert "fusion" in r["categories"]
+
+
+def test_empty_trace_raises(tmp_path):
+    _write_trace(str(tmp_path), [])
+    with pytest.raises(ValueError, match="no device HLO events"):
+        analyze_trace(str(tmp_path), steps=1)
+
+
+def test_missing_trace_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        find_trace_file(str(tmp_path))

@@ -1,0 +1,134 @@
+"""Devkit-derived validation map (data/val_maps.py).
+
+The reference ships ``imagenet_val_maps.csv`` as a blob; this framework
+derives it from the devkit and pins the result by sha256.  Tests run the
+full derivation on a synthetic devkit tar (scipy-written meta.mat + ground
+truth) and check the CSV round-trips through ``prepare_imagenet``'s loader
+in the reference's exact column order.
+"""
+
+import hashlib
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.data.val_maps import (
+    DEVKIT_GROUND_TRUTH,
+    DEVKIT_META,
+    derive_val_maps,
+    ensure_val_maps,
+    write_val_maps,
+)
+
+scipy_io = pytest.importorskip("scipy.io")
+
+N_CLASSES = 5
+N_VAL = 50_000  # derive_val_maps pins the official count
+
+
+def _fake_devkit(path: str, n_val: int = N_VAL):
+    """Devkit tar with meta.mat (struct array) + ground-truth ids."""
+    wnids = [f"n{90000000 + i:08d}" for i in range(1, N_CLASSES + 1)]
+    synsets = np.zeros((len(wnids), 1), dtype=[
+        ("ILSVRC2012_ID", object), ("WNID", object), ("words", object),
+    ])
+    for i, w in enumerate(wnids):
+        synsets[i, 0] = (np.array([[i + 1]]), np.array([w]), np.array(["x"]))
+    mat_buf = io.BytesIO()
+    scipy_io.savemat(mat_buf, {"synsets": synsets})
+
+    ids = [(i % N_CLASSES) + 1 for i in range(n_val)]
+    gt = "\n".join(str(i) for i in ids).encode() + b"\n"
+
+    with tarfile.open(path, "w:gz") as tar:
+        for name, data in ((DEVKIT_META, mat_buf.getvalue()),
+                           (DEVKIT_GROUND_TRUTH, gt)):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    return wnids, ids
+
+
+@pytest.fixture(scope="module")
+def devkit(tmp_path_factory):
+    d = tmp_path_factory.mktemp("devkit")
+    path = str(d / "ILSVRC2012_devkit_t12.tar.gz")
+    wnids, ids = _fake_devkit(path)
+    return path, wnids, ids
+
+
+def test_derivation_maps_ids_to_wnids(devkit):
+    path, wnids, ids = devkit
+    rows = derive_val_maps(path)
+    assert len(rows) == N_VAL
+    assert rows[0] == (wnids[ids[0] - 1], "ILSVRC2012_val_00000001.JPEG")
+    assert rows[-1] == (
+        wnids[ids[-1] - 1], f"ILSVRC2012_val_{N_VAL:08d}.JPEG"
+    )
+
+
+def test_written_csv_matches_reference_format_and_loader(devkit, tmp_path):
+    path, _, _ = devkit
+    rows = derive_val_maps(path)
+    out = str(tmp_path / "imagenet_val_maps.csv")
+    digest = write_val_maps(rows, out, verify=False)
+    content = open(out).read()
+    lines = content.splitlines()
+    assert lines[0] == "class,filename"  # reference header order
+    assert len(lines) == N_VAL + 1
+    assert digest == hashlib.sha256(content.encode()).hexdigest()
+
+    # prepare_imagenet's loader must consume the reference column order...
+    from distributeddeeplearning_tpu.data.prepare_imagenet import load_val_map
+
+    mapping = load_val_map(out)
+    assert len(mapping) == N_VAL
+    assert mapping["ILSVRC2012_val_00000001.JPEG"] == rows[0][0]
+
+    # ...and the transposed order operators may produce.
+    flipped = str(tmp_path / "flipped.csv")
+    with open(flipped, "w") as f:
+        f.write("filename,class\n")
+        for wnid, fname in rows[:10]:
+            f.write(f"{fname},{wnid}\n")
+    assert load_val_map(flipped)["ILSVRC2012_val_00000001.JPEG"] == rows[0][0]
+
+
+def test_verify_rejects_noncanonical_map(devkit, tmp_path):
+    path, _, _ = devkit
+    rows = derive_val_maps(path)
+    with pytest.raises(ValueError, match="sha256"):
+        write_val_maps(rows, str(tmp_path / "x.csv"), verify=True)
+    assert not os.path.exists(tmp_path / "x.csv")  # refused before writing
+
+
+def test_wrong_ground_truth_count_rejected(tmp_path):
+    path = str(tmp_path / "short.tar.gz")
+    _fake_devkit(path, n_val=10)
+    with pytest.raises(ValueError, match="50000"):
+        derive_val_maps(path)
+
+
+def test_ensure_val_maps_turnkey(devkit, tmp_path, monkeypatch):
+    path, _, _ = devkit
+    # no devkit in dir -> None (caller falls back to operator CSV)
+    assert ensure_val_maps(str(tmp_path)) is None
+    # devkit present -> derived CSV appears (verification relaxed for the
+    # synthetic devkit via monkeypatching the pinned digest)
+    import shutil
+
+    import distributeddeeplearning_tpu.data.val_maps as vm
+
+    shutil.copy(path, tmp_path / "ILSVRC2012_devkit_t12.tar.gz")
+    rows = derive_val_maps(path)
+    real_digest = hashlib.sha256(
+        ("class,filename\n" + "".join(f"{w},{f}\n" for w, f in rows)).encode()
+    ).hexdigest()
+    monkeypatch.setattr(vm, "EXPECTED_SHA256", real_digest)
+    out = ensure_val_maps(str(tmp_path))
+    assert out is not None and os.path.exists(out)
+    # idempotent: second call returns the existing file
+    assert ensure_val_maps(str(tmp_path)) == out
