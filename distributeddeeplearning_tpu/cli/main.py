@@ -211,6 +211,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     prep_p.add_argument("--target-dir", default=None)
     prep_p.add_argument("--no-checksum", action="store_true")
+    bc_p = st_sub.add_parser(
+        "build-cache",
+        help="Decode TFRecord shards once into the raw uint8 cache "
+        "(data/raw_cache.py) used by --input_pipeline raw",
+    )
+    bc_p.add_argument("--data-dir", required=True,
+                      help="TFRecord shard directory")
+    bc_p.add_argument("--split", default="train",
+                      choices=("train", "validation"))
+    bc_p.add_argument("--image-size", type=int, default=224)
+    bc_p.add_argument("--cache-dir", default=None,
+                      help="default: <data-dir>/raw-cache-<split>-<size>")
     vm_p = st_sub.add_parser(
         "val-maps",
         help="Derive imagenet_val_maps.csv from the ILSVRC2012 devkit tar "
@@ -818,6 +830,31 @@ def _cmd_storage(args) -> int:
             args.target_dir or cfg.get("DATA_DIR", "/data"),
             args.val_map,
             check_sha1=not args.no_checksum,
+        )
+        return 0
+
+    if verb == "build-cache":
+        is_training = args.split == "train"
+        from distributeddeeplearning_tpu.data.raw_cache import (
+            build_raw_cache,
+            cache_path_for,
+        )
+
+        cache_dir = args.cache_dir or cache_path_for(
+            args.data_dir, is_training, args.image_size
+        )
+        if args.dry_run:
+            print(f"[dry-run] build_raw_cache({args.data_dir}) -> {cache_dir}")
+            return 0
+        manifest = build_raw_cache(
+            args.data_dir, cache_dir, is_training, image_size=args.image_size
+        )
+        size_b = manifest.get(
+            "bytes", manifest["count"] * args.image_size**2 * 3
+        )
+        print(
+            f"{cache_dir}: {manifest['count']} images at "
+            f"{args.image_size}px ({size_b / 1e9:.1f} GB)"
         )
         return 0
 

@@ -154,10 +154,11 @@ def build_raw_cache(
             logger.info("cached %s (%d images so far)", os.path.basename(path), count)
     np.asarray(labels, "<i4").tofile(os.path.join(cache_dir, LABELS))
     want["count"] = count
+    want["bytes"] = count * image_size * image_size * 3
     with open(os.path.join(cache_dir, MANIFEST), "w") as f:
         json.dump(want, f, indent=1)
     logger.info("raw cache built: %s (%d images, %.1f GB)", cache_dir, count,
-                count * image_size * image_size * 3 / 1e9)
+                want["bytes"] / 1e9)
     return want
 
 

@@ -354,3 +354,31 @@ class TestCompletionAndRepl:
         assert main(["--env-file", str(env), "interactive", "--repl"]) == 0
         assert {"cfg", "runner", "registry", "pod", "submitter"} <= set(captured)
         assert captured["pod"].name == "pod-x"
+
+
+def test_storage_build_cache_verb(tmp_path):
+    """ddlt storage build-cache decodes a shard set into the raw cache."""
+    from distributeddeeplearning_tpu.data.bench_data import (
+        generate_bench_shards,
+    )
+    from distributeddeeplearning_tpu.data.raw_cache import open_raw_cache
+
+    d = str(tmp_path / "shards")
+    generate_bench_shards(d, num_images=6, num_shards=1, seed=3)
+    cache = str(tmp_path / "cache")
+    assert main([
+        "storage", "build-cache", "--data-dir", d, "--split", "train",
+        "--image-size", "32", "--cache-dir", cache,
+    ]) == 0
+    manifest, images, labels = open_raw_cache(cache)
+    assert manifest["count"] == 6
+    assert images.shape == (6, 32, 32, 3)
+
+    # dry-run does not build
+    assert main([
+        "--dry-run", "storage", "build-cache", "--data-dir", d,
+        "--cache-dir", str(tmp_path / "nope"),
+    ]) == 0
+    import os
+
+    assert not os.path.exists(tmp_path / "nope")

@@ -9,6 +9,7 @@ and the BERT encoder end-to-end with the kernel injected.
 from __future__ import annotations
 
 import jax
+import jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -103,3 +103,46 @@ def test_layer_count_must_divide_stages(setup):
         forward_pipelined(
             params, tokens, num_heads=HEADS, mesh=mesh, num_microbatches=1
         )
+
+
+def test_bf16_params_keep_scan_carry_dtype():
+    """Regression: the dense attention path promoted a bf16 residual stream
+    to f32 (f32 softmax output flowed into the stream), breaking the
+    scan-over-layers carry dtype contract — caught by the round-4 LM bench.
+    Both attention paths must run a full forward+grad in bf16."""
+    import jax
+    import jax.flatten_util
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        forward,
+        init_params,
+        next_token_loss,
+    )
+
+    params = init_params(
+        jax.random.key(0), num_layers=2, d_model=64, num_heads=4, d_ff=128,
+        vocab_size=97, max_len=32,
+    )
+    bf16_params = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16), params
+    )
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, 97, (2, 32)), jnp.int32
+    )
+    for attention in ("dense", "flash"):
+        logits = forward(bf16_params, toks, num_heads=4, attention=attention)
+        assert np.isfinite(np.asarray(logits, np.float32)).all()
+        grads = jax.grad(
+            lambda p, a=attention: next_token_loss(
+                forward(p, toks, num_heads=4, attention=a).astype(
+                    jnp.float32
+                ),
+                toks,
+            )
+        )(bf16_params)
+        flat, _ = jax.flatten_util.ravel_pytree(
+            jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        )
+        assert np.isfinite(np.asarray(flat)).all()

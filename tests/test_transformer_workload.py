@@ -78,3 +78,11 @@ def test_runner_flag_surface():
     finally:
         sys.argv = argv
     assert np.isfinite(fit.final_train_metrics["loss"])
+
+
+def test_lm_flash_attention_flag_trains():
+    """--attention flash routes the workload through the causal Pallas
+    kernel (interpret mode on CPU); loss finite, same step count."""
+    state, fit = lm_main(attention="flash", **TINY)
+    assert int(state.step) == fit.epochs_run * (64 // (2 * 8))
+    assert np.isfinite(fit.final_train_metrics["loss"])
