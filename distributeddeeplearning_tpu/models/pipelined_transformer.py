@@ -78,6 +78,7 @@ def block_apply(
     *,
     num_heads: int,
     attention: str = "dense",
+    attention_fn=None,
 ):
     """One pre-LN transformer block; ``p`` leaves are per-layer ([...] no L).
 
@@ -85,6 +86,13 @@ def block_apply(
     tril mask; ``"flash"`` runs the causal Pallas kernel
     (``ops.flash_attention`` with ``causal=True``) — O(block²) memory and
     ~half the FLOPs, the long-context decoder path.  Both are exact.
+
+    ``attention_fn`` overrides both: a ``(q, k, v, mask, *, dtype)``
+    callable in ``[B, S, H, D]`` layout (the ``models.bert`` contract) that
+    must enforce causality itself — bind
+    ``ops.make_ring_attention(mesh, causal=True)`` or
+    ``ops.make_ulysses_attention(mesh, causal=True)`` for the
+    sequence-parallel decoder.
     """
     b, s, d = x.shape
     hd = d // num_heads
@@ -92,7 +100,12 @@ def block_apply(
     h = _layer_norm(x, p["ln1"])
     qkv = h @ p["qkv"]  # [b, s, 3d]
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    if attention == "flash":
+    if attention_fn is not None:
+        split4 = lambda t: t.reshape(b, s, num_heads, hd)  # noqa: E731
+        ctx = attention_fn(
+            split4(q), split4(k), split4(v), None, dtype=x.dtype
+        ).reshape(b, s, d).astype(x.dtype)
+    elif attention == "flash":
         from distributeddeeplearning_tpu.ops.flash_attention import (
             flash_attention,
         )
@@ -126,14 +139,20 @@ def block_apply(
 
 
 def _stack_scan(
-    blocks: PyTree, x: jax.Array, *, num_heads: int, attention: str = "dense"
+    blocks: PyTree,
+    x: jax.Array,
+    *,
+    num_heads: int,
+    attention: str = "dense",
+    attention_fn=None,
 ) -> jax.Array:
     """lax.scan over the stacked layer dim — one compiled block body."""
 
     def body(carry, layer_params):
         return (
             block_apply(
-                layer_params, carry, num_heads=num_heads, attention=attention
+                layer_params, carry, num_heads=num_heads, attention=attention,
+                attention_fn=attention_fn,
             ),
             None,
         )
@@ -152,10 +171,27 @@ def _embed(params, tokens):
     return x + params["pos"][: tokens.shape[1]][None]
 
 
-def forward(params, tokens, *, num_heads: int, attention: str = "dense") -> jax.Array:
-    """Next-token logits [b, s, vocab] — sequential (scan over all layers)."""
+def forward(
+    params,
+    tokens,
+    *,
+    num_heads: int,
+    attention: str = "dense",
+    attention_fn=None,
+) -> jax.Array:
+    """Next-token logits [b, s, vocab] — sequential (scan over all layers).
+
+    ``attention_fn`` (see :func:`block_apply`) plugs a causal
+    sequence-parallel attention (ring / Ulysses) into every layer — the
+    multi-chip long-context decoder path.  Sequential forward only: the
+    SP ops shard_map over the mesh themselves, which cannot nest inside
+    ``forward_pipelined``'s pipe-axis shard_map.
+    """
     x = _embed(params, tokens)
-    x = _stack_scan(params["blocks"], x, num_heads=num_heads, attention=attention)
+    x = _stack_scan(
+        params["blocks"], x, num_heads=num_heads, attention=attention,
+        attention_fn=attention_fn,
+    )
     return x @ params["head"]
 
 

@@ -34,7 +34,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
 
 
-def _ulysses_body(q, k, v, mask, *, axis_name: str, n: int, dtype):
+def _ulysses_body(q, k, v, mask, *, axis_name: str, n: int, dtype,
+                  causal: bool = False):
     """Runs inside shard_map: q/k/v ``[B, S/n, H, D]`` locally."""
     from distributeddeeplearning_tpu.models.bert import dot_product_attention
 
@@ -55,6 +56,15 @@ def _ulysses_body(q, k, v, mask, *, axis_name: str, n: int, dtype):
     # The key-padding mask is per-token: gather the full sequence's mask
     # (bool bits — cheap) so local attention sees all S key positions.
     mask_full = jax.lax.all_gather(mask, axis_name, axis=3, tiled=True)
+    if causal:
+        # After the all-to-all each device holds the FULL sequence (for
+        # H/n heads) in global order, so the causal triangle is the plain
+        # local tril — no position bookkeeping needed (contrast the ring,
+        # which masks in global coordinates per tick).
+        s = qh.shape[1]
+        mask_full = jnp.logical_and(
+            mask_full, jnp.tril(jnp.ones((s, s), bool))[None, None]
+        )
     ctx = dot_product_attention(qh, kh, vh, mask_full, dtype=dtype)
     return to_tokens(ctx)
 
@@ -68,15 +78,25 @@ def ulysses_attention(
     mesh: Mesh,
     dtype: jnp.dtype,
     axis_name: str = "seq",
+    causal: bool = False,
 ):
     """All-to-all sequence-parallel attention; drop-in for
-    :func:`models.bert.dot_product_attention` ([B, S, H, D] global)."""
+    :func:`models.bert.dot_product_attention` ([B, S, H, D] global).
+
+    ``causal=True`` applies the autoregressive triangle (decoder models):
+    after the tokens→heads all-to-all each device sees the full sequence,
+    so causality is an ordinary local tril over the gathered mask.
+    """
     from distributeddeeplearning_tpu.parallel.compat import shard_map
 
     n = int(mesh.shape[axis_name])
     if n == 1:
         from distributeddeeplearning_tpu.models.bert import dot_product_attention
 
+        if causal:
+            s = q.shape[1]
+            tril = jnp.tril(jnp.ones((s, s), bool))[None, None]
+            mask = tril if mask is None else jnp.logical_and(mask, tril)
         return dot_product_attention(q, k, v, mask, dtype=dtype)
     heads = q.shape[2]
     if heads % n:
@@ -91,7 +111,9 @@ def ulysses_attention(
 
     qkv_spec = P(DATA_AXES, axis_name, None, None)
     mask_spec = P(DATA_AXES, None, None, axis_name)
-    body = partial(_ulysses_body, axis_name=axis_name, n=n, dtype=dtype)
+    body = partial(
+        _ulysses_body, axis_name=axis_name, n=n, dtype=dtype, causal=causal
+    )
     return shard_map(
         body,
         mesh=mesh,
@@ -100,12 +122,15 @@ def ulysses_attention(
     )(q, k, v, mask)
 
 
-def make_ulysses_attention(mesh: Mesh, axis_name: str = "seq"):
+def make_ulysses_attention(
+    mesh: Mesh, axis_name: str = "seq", causal: bool = False
+):
     """Bind a mesh → an ``attention_fn`` for the transformer models."""
 
     def attention_fn(q, k, v, mask, *, dtype):
         return ulysses_attention(
-            q, k, v, mask, mesh=mesh, dtype=dtype, axis_name=axis_name
+            q, k, v, mask, mesh=mesh, dtype=dtype, axis_name=axis_name,
+            causal=causal,
         )
 
     return attention_fn

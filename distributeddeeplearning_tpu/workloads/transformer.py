@@ -82,12 +82,15 @@ def main(
     compute_dtype: str = "bfloat16",
     distributed: Optional[bool] = None,
     data_format: str = "synthetic",  # LM data is synthetic-only (see module doc)
-    # parallelism geometry: pipeline stages × data parallelism (remainder)
+    # parallelism geometry: pipeline stages × sequence × data (remainder)
     pipe: int = 1,
+    seq: int = 1,  # sequence-parallel axis (ring / ulysses attention)
     num_slices: int = 1,  # multi-slice (DCN) data parallelism
     num_microbatches: int = 8,
     remat: bool = False,  # jax.checkpoint each pipeline tick (ops/pipeline.py)
-    attention: str = "dense",  # "flash" = causal Pallas kernel (long context)
+    # "flash" = causal Pallas kernel (long context, single shard);
+    # "ring"/"ulysses" = causal sequence-parallel attention over --seq
+    attention: str = "dense",
 ):
     """Train; returns (state, FitResult)."""
     import jax
@@ -124,8 +127,32 @@ def main(
         raise ValueError(
             f"num_layers {num_layers} not divisible by pipe {pipe}"
         )
+    # Sequence parallelism: the SP attention ops shard_map over the mesh
+    # themselves, which cannot nest inside the pipeline's shard_map — the
+    # two long-context axes compose with data parallelism, not each other.
+    if pipe > 1 and (seq > 1 or attention in ("ring", "ulysses")):
+        raise ValueError(
+            "pipe and sequence parallelism are mutually exclusive: the "
+            "sequence-parallel attention cannot run inside a pipeline stage"
+        )
+    if seq > 1 and attention not in ("ring", "ulysses"):
+        raise ValueError(
+            f"seq={seq} requires attention='ring' or 'ulysses', got "
+            f"{attention!r}"
+        )
+    if attention in ("ring", "ulysses") and seq_len % max(seq, 1):
+        raise ValueError(f"seq_len {seq_len} not divisible by seq axis {seq}")
     ctx = initialize(force=distributed)
-    mesh = create_mesh(MeshSpec(pipe=pipe), num_slices=num_slices)
+    mesh = create_mesh(MeshSpec(pipe=pipe, seq=seq), num_slices=num_slices)
+    attention_fn = None
+    if attention == "ring":
+        from distributeddeeplearning_tpu.ops import make_ring_attention
+
+        attention_fn = make_ring_attention(mesh, causal=True)
+    elif attention == "ulysses":
+        from distributeddeeplearning_tpu.ops import make_ulysses_attention
+
+        attention_fn = make_ulysses_attention(mesh, causal=True)
     data_shards = mesh.shape["data"] * mesh.shape["fsdp"]
     global_batch = batch_size * data_shards
     per_host_batch = global_batch // ctx.process_count
@@ -172,7 +199,7 @@ def main(
             )
         else:
             logits = forward(p, tokens, num_heads=num_heads,
-                             attention=attention)
+                             attention=attention, attention_fn=attention_fn)
         logits = logits.astype(jnp.float32)
         if mutable is not None:
             return logits, {}

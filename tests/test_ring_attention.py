@@ -222,3 +222,92 @@ def test_invalid_block_rejected(qkv, padding_mask):
         ring_attention(
             q, k, v, padding_mask, mesh=mesh, dtype=jnp.float32, block_k=3
         )
+
+
+# ---------------------------------------------------------------------------
+# Causal ring (round 4): the autoregressive triangle applied in GLOBAL
+# positions — each tick's mask is full/triangular/empty depending on where
+# the rotating kv block sits relative to this shard's queries.  Oracle:
+# dense attention over the combined padding & tril mask.
+# ---------------------------------------------------------------------------
+
+
+def _dense_causal(q, k, v, mask):
+    s = q.shape[1]
+    tril = jnp.tril(jnp.ones((s, s), bool))[None, None]
+    full = tril if mask is None else jnp.logical_and(mask, tril)
+    return dot_product_attention(q, k, v, full, dtype=jnp.float32)
+
+
+@pytest.mark.parametrize("ring_size", [2, 4, 8])
+def test_causal_matches_dense(qkv, padding_mask, ring_size):
+    q, k, v = qkv
+    mesh = create_mesh(MeshSpec(seq=ring_size))
+    dense = _dense_causal(q, k, v, padding_mask)
+    ring = ring_attention(
+        q, k, v, padding_mask, mesh=mesh, dtype=jnp.float32, causal=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(ring), np.asarray(dense), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_causal_no_mask_matches_dense(qkv):
+    q, k, v = qkv
+    mesh = create_mesh(MeshSpec(seq=4))
+    dense = _dense_causal(q, k, v, None)
+    ring = ring_attention(
+        q, k, v, None, mesh=mesh, dtype=jnp.float32, causal=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(ring), np.asarray(dense), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.parametrize("block_k", [1, 2, 4])
+def test_causal_blocked_matches_dense(qkv, padding_mask, block_k):
+    q, k, v = qkv
+    mesh = create_mesh(MeshSpec(seq=4))
+    dense = _dense_causal(q, k, v, padding_mask)
+    ring = ring_attention(
+        q, k, v, padding_mask, mesh=mesh, dtype=jnp.float32, causal=True,
+        block_k=block_k,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ring), np.asarray(dense), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_causal_gradients_match_dense(qkv, padding_mask):
+    q, k, v = qkv
+    mesh = create_mesh(MeshSpec(seq=4))
+
+    def dense_loss(q):
+        return (_dense_causal(q, k, v, padding_mask) ** 2).sum()
+
+    def ring_loss(q):
+        return (
+            ring_attention(
+                q, k, v, padding_mask, mesh=mesh, dtype=jnp.float32,
+                causal=True, block_k=2,
+            )
+            ** 2
+        ).sum()
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(ring_loss)(q)),
+        np.asarray(jax.grad(dense_loss)(q)),
+        atol=5e-4, rtol=5e-4,
+    )
+
+
+def test_causal_seq_axis_one_falls_back_to_dense(qkv, padding_mask):
+    q, k, v = qkv
+    mesh = create_mesh(MeshSpec())  # seq=1
+    dense = _dense_causal(q, k, v, padding_mask)
+    ring = ring_attention(
+        q, k, v, padding_mask, mesh=mesh, dtype=jnp.float32, causal=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(ring), np.asarray(dense), atol=1e-6
+    )

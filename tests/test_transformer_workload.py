@@ -86,3 +86,20 @@ def test_lm_flash_attention_flag_trains():
     state, fit = lm_main(attention="flash", **TINY)
     assert int(state.step) == fit.epochs_run * (64 // (2 * 8))
     assert np.isfinite(fit.final_train_metrics["loss"])
+
+
+@pytest.mark.parametrize("scheme", ["ring", "ulysses"])
+def test_lm_sequence_parallel_attention_trains(scheme):
+    """--attention ring|ulysses with --seq 2: the causal sequence-parallel
+    decoder path (round 4) trains end-to-end on the virtual pod."""
+    state, fit = lm_main(attention=scheme, seq=2, **TINY)
+    # seq=2 leaves 4 data shards: global batch 2*4=8 -> 8 steps/epoch
+    assert int(state.step) == fit.epochs_run * 8
+    assert np.isfinite(fit.final_train_metrics["loss"])
+
+
+def test_lm_seq_parallel_flag_validation():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        lm_main(attention="ring", seq=2, pipe=2, **TINY)
+    with pytest.raises(ValueError, match="ring"):
+        lm_main(attention="dense", seq=2, **TINY)

@@ -101,3 +101,69 @@ def test_bert_workload_ulysses_trains():
         distributed=False,
     )
     assert np.isfinite(fit.final_train_metrics["loss"])
+
+
+# ---------------------------------------------------------------------------
+# Causal Ulysses (round 4): after the tokens->heads all-to-all each device
+# holds the full sequence, so causality is a local tril over the gathered
+# mask.  Oracle: dense attention over the combined padding & tril mask.
+# ---------------------------------------------------------------------------
+
+
+def _dense_causal(q, k, v, mask):
+    s = q.shape[1]
+    tril = jnp.tril(jnp.ones((s, s), bool))[None, None]
+    full = tril if mask is None else jnp.logical_and(mask, tril)
+    return dot_product_attention(q, k, v, full, dtype=jnp.float32)
+
+
+@pytest.mark.parametrize("n", [2, 4])  # heads=4 caps the seq axis
+def test_causal_matches_dense(n):
+    q, k, v, mask = _inputs(3)
+    mesh = create_mesh(MeshSpec(seq=n))
+    dense = _dense_causal(q, k, v, mask)
+    out = ulysses_attention(
+        q, k, v, mask, mesh=mesh, dtype=jnp.float32, causal=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_causal_no_mask_and_gradients():
+    q, k, v, _ = _inputs(4)
+    mesh = create_mesh(MeshSpec(seq=4))
+    dense = _dense_causal(q, k, v, None)
+    out = ulysses_attention(
+        q, k, v, None, mesh=mesh, dtype=jnp.float32, causal=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense), atol=2e-5, rtol=2e-5
+    )
+
+    def dense_loss(q):
+        return (_dense_causal(q, k, v, None) ** 2).sum()
+
+    def uly_loss(q):
+        return (
+            ulysses_attention(
+                q, k, v, None, mesh=mesh, dtype=jnp.float32, causal=True
+            )
+            ** 2
+        ).sum()
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(uly_loss)(q)),
+        np.asarray(jax.grad(dense_loss)(q)),
+        atol=5e-4, rtol=5e-4,
+    )
+
+
+def test_causal_seq_axis_one_falls_back_to_dense():
+    q, k, v, mask = _inputs(5)
+    mesh = create_mesh(MeshSpec())  # seq=1
+    dense = _dense_causal(q, k, v, mask)
+    out = ulysses_attention(
+        q, k, v, mask, mesh=mesh, dtype=jnp.float32, causal=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=1e-6)
