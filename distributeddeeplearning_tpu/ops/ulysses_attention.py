@@ -35,7 +35,8 @@ from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
 
 
 def _ulysses_body(q, k, v, mask, *, axis_name: str, n: int, dtype,
-                  causal: bool = False):
+                  causal: bool = False, use_flash: bool = False,
+                  block_q: int = 512, block_k: int = 512):
     """Runs inside shard_map: q/k/v ``[B, S/n, H, D]`` locally."""
     from distributeddeeplearning_tpu.models.bert import dot_product_attention
 
@@ -56,6 +57,21 @@ def _ulysses_body(q, k, v, mask, *, axis_name: str, n: int, dtype,
     # The key-padding mask is per-token: gather the full sequence's mask
     # (bool bits — cheap) so local attention sees all S key positions.
     mask_full = jax.lax.all_gather(mask, axis_name, axis=3, tiled=True)
+    if use_flash:
+        # Ulysses×flash: the local attention IS a plain full-sequence
+        # attention over H/n heads, so the Pallas kernel drops in —
+        # O(block²) score memory and (causal) masked-tile skip, composed
+        # with the all-to-all re-sharding.  The kernel consumes the
+        # key-padding mask directly and applies the triangle in-kernel.
+        from distributeddeeplearning_tpu.ops.flash_attention import (
+            flash_attention,
+        )
+
+        ctx = flash_attention(
+            qh, kh, vh, mask_full, dtype=dtype, causal=causal,
+            block_q=block_q, block_k=block_k,
+        )
+        return to_tokens(ctx)
     if causal:
         # After the all-to-all each device holds the FULL sequence (for
         # H/n heads) in global order, so the causal triangle is the plain
@@ -79,6 +95,9 @@ def ulysses_attention(
     dtype: jnp.dtype,
     axis_name: str = "seq",
     causal: bool = False,
+    use_flash: bool = False,
+    block_q: int = 512,
+    block_k: int = 512,
 ):
     """All-to-all sequence-parallel attention; drop-in for
     :func:`models.bert.dot_product_attention` ([B, S, H, D] global).
@@ -86,11 +105,25 @@ def ulysses_attention(
     ``causal=True`` applies the autoregressive triangle (decoder models):
     after the tokens→heads all-to-all each device sees the full sequence,
     so causality is an ordinary local tril over the gathered mask.
+
+    ``use_flash=True`` runs the local per-device attention through the
+    Pallas flash kernel (``ops.flash_attention``) instead of the dense
+    score matrix — the Ulysses×flash composition: O(block²) local memory
+    and the causal masked-tile skip, at full sequence length per device.
     """
     from distributeddeeplearning_tpu.parallel.compat import shard_map
 
     n = int(mesh.shape[axis_name])
     if n == 1:
+        if use_flash:
+            from distributeddeeplearning_tpu.ops.flash_attention import (
+                flash_attention,
+            )
+
+            return flash_attention(
+                q, k, v, mask, dtype=dtype, causal=causal,
+                block_q=block_q, block_k=block_k,
+            )
         from distributeddeeplearning_tpu.models.bert import dot_product_attention
 
         if causal:
@@ -112,7 +145,8 @@ def ulysses_attention(
     qkv_spec = P(DATA_AXES, axis_name, None, None)
     mask_spec = P(DATA_AXES, None, None, axis_name)
     body = partial(
-        _ulysses_body, axis_name=axis_name, n=n, dtype=dtype, causal=causal
+        _ulysses_body, axis_name=axis_name, n=n, dtype=dtype, causal=causal,
+        use_flash=use_flash, block_q=block_q, block_k=block_k,
     )
     return shard_map(
         body,
@@ -123,14 +157,20 @@ def ulysses_attention(
 
 
 def make_ulysses_attention(
-    mesh: Mesh, axis_name: str = "seq", causal: bool = False
+    mesh: Mesh,
+    axis_name: str = "seq",
+    causal: bool = False,
+    use_flash: bool = False,
+    block_q: int = 512,
+    block_k: int = 512,
 ):
     """Bind a mesh → an ``attention_fn`` for the transformer models."""
 
     def attention_fn(q, k, v, mask, *, dtype):
         return ulysses_attention(
             q, k, v, mask, mesh=mesh, dtype=dtype, axis_name=axis_name,
-            causal=causal,
+            causal=causal, use_flash=use_flash, block_q=block_q,
+            block_k=block_k,
         )
 
     return attention_fn

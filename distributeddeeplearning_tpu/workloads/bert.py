@@ -198,17 +198,22 @@ def main(
     # the fused XLA path.
     if attention == "auto":
         attention = "ring" if seq > 1 else "default"
-    if seq > 1 and attention not in ("ring", "ulysses"):
+    if seq > 1 and attention not in ("ring", "ulysses", "ulysses-flash"):
         raise ValueError(
-            f"seq={seq} requires attention='ring' or 'ulysses', got "
-            f"{attention!r}"
+            f"seq={seq} requires attention='ring', 'ulysses' or "
+            f"'ulysses-flash', got {attention!r}"
         )
     if attention == "ring":
         model_kwargs["attention_fn"] = make_ring_attention(mesh)
-    elif attention == "ulysses":
+    elif attention in ("ulysses", "ulysses-flash"):
         from distributeddeeplearning_tpu.ops import make_ulysses_attention
 
-        model_kwargs["attention_fn"] = make_ulysses_attention(mesh)
+        # "ulysses-flash" routes the per-device local attention through the
+        # Pallas kernel (the Ulysses×flash composition) — the long-context
+        # multi-chip encoder path with flash-level local memory.
+        model_kwargs["attention_fn"] = make_ulysses_attention(
+            mesh, use_flash=attention == "ulysses-flash"
+        )
     elif attention == "flash":
         from distributeddeeplearning_tpu.ops.flash_attention import (
             make_flash_attention,

@@ -130,17 +130,18 @@ def main(
     # Sequence parallelism: the SP attention ops shard_map over the mesh
     # themselves, which cannot nest inside the pipeline's shard_map — the
     # two long-context axes compose with data parallelism, not each other.
-    if pipe > 1 and (seq > 1 or attention in ("ring", "ulysses")):
+    _sp_modes = ("ring", "ulysses", "ulysses-flash")
+    if pipe > 1 and (seq > 1 or attention in _sp_modes):
         raise ValueError(
             "pipe and sequence parallelism are mutually exclusive: the "
             "sequence-parallel attention cannot run inside a pipeline stage"
         )
-    if seq > 1 and attention not in ("ring", "ulysses"):
+    if seq > 1 and attention not in _sp_modes:
         raise ValueError(
-            f"seq={seq} requires attention='ring' or 'ulysses', got "
-            f"{attention!r}"
+            f"seq={seq} requires attention='ring', 'ulysses' or "
+            f"'ulysses-flash', got {attention!r}"
         )
-    if attention in ("ring", "ulysses") and seq_len % max(seq, 1):
+    if attention in _sp_modes and seq_len % max(seq, 1):
         raise ValueError(f"seq_len {seq_len} not divisible by seq axis {seq}")
     ctx = initialize(force=distributed)
     mesh = create_mesh(MeshSpec(pipe=pipe, seq=seq), num_slices=num_slices)
@@ -149,10 +150,12 @@ def main(
         from distributeddeeplearning_tpu.ops import make_ring_attention
 
         attention_fn = make_ring_attention(mesh, causal=True)
-    elif attention == "ulysses":
+    elif attention in ("ulysses", "ulysses-flash"):
         from distributeddeeplearning_tpu.ops import make_ulysses_attention
 
-        attention_fn = make_ulysses_attention(mesh, causal=True)
+        attention_fn = make_ulysses_attention(
+            mesh, causal=True, use_flash=attention == "ulysses-flash"
+        )
     data_shards = mesh.shape["data"] * mesh.shape["fsdp"]
     global_batch = batch_size * data_shards
     per_host_batch = global_batch // ctx.process_count

@@ -88,7 +88,7 @@ def test_lm_flash_attention_flag_trains():
     assert np.isfinite(fit.final_train_metrics["loss"])
 
 
-@pytest.mark.parametrize("scheme", ["ring", "ulysses"])
+@pytest.mark.parametrize("scheme", ["ring", "ulysses", "ulysses-flash"])
 def test_lm_sequence_parallel_attention_trains(scheme):
     """--attention ring|ulysses with --seq 2: the causal sequence-parallel
     decoder path (round 4) trains end-to-end on the virtual pod."""
