@@ -305,3 +305,52 @@ def test_pipeline_emits_collective_permutes():
         hlo, "collective-permute-start"
     )
     assert cp, "pipeline compiled without collective-permute rotation"
+
+
+def test_causal_ring_lm_emits_collective_permutes():
+    """The causal sequence-parallel decoder (round 4): the LM train step
+    with ring attention (causal=True) must still compile to the ppermute
+    k/v rotation — causality is masking, not a different communication
+    pattern."""
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        forward,
+        init_params,
+        next_token_loss,
+    )
+    from distributeddeeplearning_tpu.ops import make_ring_attention
+    from distributeddeeplearning_tpu.train.state import TrainState
+
+    mesh = create_mesh(MeshSpec(seq=2), devices=jax.devices()[:N_DEV])
+    ring_fn = make_ring_attention(mesh, causal=True)
+    params = init_params(
+        jax.random.key(0), num_layers=2, d_model=32, num_heads=2, d_ff=64,
+        vocab_size=64, max_len=16,
+    )
+
+    def apply_fn(variables, tokens, train=True, mutable=None, rngs=None):
+        logits = forward(
+            variables["params"], tokens, num_heads=2, attention_fn=ring_fn
+        )
+        if mutable is not None:
+            return logits, {}
+        return logits
+
+    tx = optax.sgd(0.1)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        opt_state=tx.init(params), batch_stats={}, apply_fn=apply_fn, tx=tx,
+    )
+    step = build_train_step(
+        mesh, state, compute_dtype=jnp.float32,
+        loss_fn=lambda lg, lb, label_smoothing=0.0: next_token_loss(lg, lb),
+        metrics_fn=lambda lg, lb, loss: {"loss": loss.astype(jnp.float32)},
+    )
+    rows = 2 * (N_DEV // 2)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, (rows, 16)).astype(np.int32)
+    batch = shard_batch(mesh, {"input": toks, "label": toks})
+    hlo = compiled_hlo(step, state, batch)
+    cp = collective_ops(hlo, "collective-permute") + collective_ops(
+        hlo, "collective-permute-start"
+    )
+    assert cp, "causal ring LM compiled without collective-permute"
