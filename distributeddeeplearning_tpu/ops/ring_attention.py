@@ -85,12 +85,17 @@ def _ring_body(q, k, v, mask, *, axis_name: str, ring: int, out_dtype,
     ``causal`` applies the autoregressive triangle in GLOBAL positions:
     this shard's queries live at ``rank·Sq + [0, Sq)`` and the tick's keys
     at ``src·Skv + [0, Skv)``, so each tick's mask is full (src < rank),
-    triangular (src == rank) or empty (src > rank).  Masking is exact; the
-    ring still runs all ``n`` ticks because the scan body is collective —
-    at every tick some device owns a live block, so skipping the dead ones
-    does not shorten the lockstep critical path (a load-balanced striped
-    layout is the known further optimization and would change the data
-    contract).
+    triangular (src == rank) or empty (src > rank).  Fully-dead work is
+    SKIPPED, not just masked: a ``lax.cond`` wraps the online update at
+    both the tick and the ``block_k``-chunk level (live iff the last query
+    position can see the first key position), so a dead tick costs only
+    its ppermute — the ring-level analogue of the flash kernel's
+    masked-tile skip.  The cond is legal because the rotation collectives
+    sit outside it, keeping the scan body collective-uniform across
+    devices.  Masking is exact either way; the lockstep critical path
+    still runs all ``n`` ticks (at every tick some device owns a live
+    block) — a load-balanced striped layout is the known further
+    optimization and would change the data contract.
     """
     depth = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(depth, jnp.float32))
@@ -124,10 +129,22 @@ def _ring_body(q, k, v, mask, *, axis_name: str, ring: int, out_dtype,
             if causal:
                 k_pos = src * skv + jnp.arange(skv, dtype=jnp.int32)
                 # [B,1,1,Skv] & [1,1,Sq,Skv] -> [B,1,Sq,Skv]
-                mask_r = jnp.logical_and(
+                mask_c = jnp.logical_and(
                     mask_r, (q_pos[:, None] >= k_pos[None, :])[None, None]
                 )
-            m, l, o = _online_update(q, k, v, mask_r, m, l, o, scale)
+                # Skip the tick's matmuls when every (q, k) pair is
+                # future-masked: live iff the LAST query can see the FIRST
+                # key.  The rotation below stays outside the cond.
+                m, l, o = jax.lax.cond(
+                    q_pos[-1] >= src * skv,
+                    lambda m, l, o: _online_update(
+                        q, k, v, mask_c, m, l, o, scale
+                    ),
+                    lambda m, l, o: (m, l, o),
+                    m, l, o,
+                )
+            else:
+                m, l, o = _online_update(q, k, v, mask_r, m, l, o, scale)
         else:
             nchunks = skv // block_k
             # [nchunks, B, block_k, H, D] — leading scan axis
@@ -142,15 +159,26 @@ def _ring_body(q, k, v, mask, *, axis_name: str, ring: int, out_dtype,
                 kc, vc, mc, c = xs
                 if causal:
                     # chunk keys at global src*Skv + c*block_k + [0, block_k)
-                    kc_pos = (
-                        src * skv
-                        + c * block_k
-                        + jnp.arange(block_k, dtype=jnp.int32)
-                    )
-                    mc = jnp.logical_and(
+                    k0 = src * skv + c * block_k
+                    kc_pos = k0 + jnp.arange(block_k, dtype=jnp.int32)
+                    mcc = jnp.logical_and(
                         mc, (q_pos[:, None] >= kc_pos[None, :])[None, None]
                     )
-                im, il, io = _online_update(q, kc, vc, mc, im, il, io, scale)
+                    # Fully-future chunks skip their matmuls (see tick-level
+                    # cond above); no collectives inside the inner scan, so
+                    # the branch is unconditionally legal.
+                    im, il, io = jax.lax.cond(
+                        q_pos[-1] >= k0,
+                        lambda im, il, io: _online_update(
+                            q, kc, vc, mcc, im, il, io, scale
+                        ),
+                        lambda im, il, io: (im, il, io),
+                        im, il, io,
+                    )
+                else:
+                    im, il, io = _online_update(
+                        q, kc, vc, mc, im, il, io, scale
+                    )
                 return (im, il, io), None
 
             (m, l, o), _ = jax.lax.scan(
