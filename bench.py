@@ -155,7 +155,8 @@ def _build_lm_bench(args, devices=None):
             variables["params"],
         )
         logits = forward(
-            p, tokens, num_heads=dims["num_heads"], attention=attention
+            p, tokens, num_heads=dims["num_heads"], attention=attention,
+            remat=args.remat != "none",
         ).astype(jnp.float32)
         if mutable is not None:
             return logits, {}

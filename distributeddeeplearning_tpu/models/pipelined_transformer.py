@@ -145,8 +145,15 @@ def _stack_scan(
     num_heads: int,
     attention: str = "dense",
     attention_fn=None,
+    remat: bool = False,
 ) -> jax.Array:
-    """lax.scan over the stacked layer dim — one compiled block body."""
+    """lax.scan over the stacked layer dim — one compiled block body.
+
+    ``remat=True`` wraps the body in ``jax.checkpoint`` so backward
+    recomputes each layer instead of saving its activations — activation
+    memory O(1) in depth, the long-context enabler (seq-32k needs it: 12
+    saved [S, d_ff] intermediates alone are 2.25 GB bf16 at S=32k).
+    """
 
     def body(carry, layer_params):
         return (
@@ -157,6 +164,8 @@ def _stack_scan(
             None,
         )
 
+    if remat:
+        body = jax.checkpoint(body)
     out, _ = jax.lax.scan(body, x, blocks)
     return out
 
@@ -178,6 +187,7 @@ def forward(
     num_heads: int,
     attention: str = "dense",
     attention_fn=None,
+    remat: bool = False,
 ) -> jax.Array:
     """Next-token logits [b, s, vocab] — sequential (scan over all layers).
 
@@ -186,11 +196,14 @@ def forward(
     multi-chip long-context decoder path.  Sequential forward only: the
     SP ops shard_map over the mesh themselves, which cannot nest inside
     ``forward_pipelined``'s pipe-axis shard_map.
+
+    ``remat=True`` rematerializes each layer in backward (see
+    :func:`_stack_scan`).
     """
     x = _embed(params, tokens)
     x = _stack_scan(
         params["blocks"], x, num_heads=num_heads, attention=attention,
-        attention_fn=attention_fn,
+        attention_fn=attention_fn, remat=remat,
     )
     return x @ params["head"]
 

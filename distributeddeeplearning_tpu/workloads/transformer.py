@@ -87,7 +87,9 @@ def main(
     seq: int = 1,  # sequence-parallel axis (ring / ulysses attention)
     num_slices: int = 1,  # multi-slice (DCN) data parallelism
     num_microbatches: int = 8,
-    remat: bool = False,  # jax.checkpoint each pipeline tick (ops/pipeline.py)
+    # jax.checkpoint each pipeline tick (pipe>1, ops/pipeline.py) or each
+    # layer of the sequential scan (pipe=1) — the long-context memory lever
+    remat: bool = False,
     # "flash" = causal Pallas kernel (long context, single shard);
     # "ring"/"ulysses" = causal sequence-parallel attention over --seq
     attention: str = "dense",
@@ -202,7 +204,8 @@ def main(
             )
         else:
             logits = forward(p, tokens, num_heads=num_heads,
-                             attention=attention, attention_fn=attention_fn)
+                             attention=attention, attention_fn=attention_fn,
+                             remat=remat)
         logits = logits.astype(jnp.float32)
         if mutable is not None:
             return logits, {}
