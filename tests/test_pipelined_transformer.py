@@ -146,3 +146,45 @@ def test_bf16_params_keep_scan_carry_dtype():
             jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
         )
         assert np.isfinite(np.asarray(flat)).all()
+
+
+def test_remat_matches_no_remat():
+    """remat=True must be a pure memory/time trade: identical logits and
+    gradients to the plain scan (jax.checkpoint changes scheduling, not
+    math)."""
+    import jax
+    import jax.flatten_util
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        forward,
+        init_params,
+        next_token_loss,
+    )
+
+    params = init_params(
+        jax.random.key(2), num_layers=3, d_model=48, num_heads=2, d_ff=96,
+        vocab_size=89, max_len=24,
+    )
+    toks = jnp.asarray(
+        np.random.default_rng(5).integers(0, 89, (2, 24)), jnp.int32
+    )
+
+    def loss(p, remat):
+        return next_token_loss(
+            forward(p, toks, num_heads=2, remat=remat), toks
+        )
+
+    np.testing.assert_allclose(
+        float(loss(params, False)), float(loss(params, True)), rtol=1e-6
+    )
+    g0, _ = jax.flatten_util.ravel_pytree(
+        jax.grad(lambda p: loss(p, False))(params)
+    )
+    g1, _ = jax.flatten_util.ravel_pytree(
+        jax.grad(lambda p: loss(p, True))(params)
+    )
+    np.testing.assert_allclose(
+        np.asarray(g0), np.asarray(g1), atol=1e-6, rtol=1e-5
+    )
