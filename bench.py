@@ -124,6 +124,7 @@ def _build_lm_bench(args, devices=None):
         forward,
         init_params,
         next_token_loss,
+        per_token_loss,
     )
     from distributeddeeplearning_tpu.parallel import (
         MeshSpec,
@@ -154,13 +155,22 @@ def _build_lm_bench(args, devices=None):
             if jnp.issubdtype(a.dtype, jnp.floating) else a,
             variables["params"],
         )
-        logits = forward(
-            p, tokens, num_heads=dims["num_heads"], attention=attention,
-            remat=args.remat != "none",
-        ).astype(jnp.float32)
+        if args.loss_chunk:
+            # Fused head+CE: "logits" are the per-position losses [b, s-1]
+            # (full [b, s, vocab] f32 logits never materialize — the seq-64k
+            # memory lever; see models.pipelined_transformer.per_token_loss).
+            out = per_token_loss(
+                p, tokens, num_heads=dims["num_heads"], attention=attention,
+                remat=args.remat != "none", loss_chunk=args.loss_chunk,
+            )
+        else:
+            out = forward(
+                p, tokens, num_heads=dims["num_heads"], attention=attention,
+                remat=args.remat != "none",
+            ).astype(jnp.float32)
         if mutable is not None:
-            return logits, {}
-        return logits
+            return out, {}
+        return out
 
     tx = optax.adamw(1e-4)
     state = TrainState(
@@ -168,9 +178,13 @@ def _build_lm_bench(args, devices=None):
         opt_state=tx.init(params), batch_stats={},
         apply_fn=apply_fn, tx=tx,
     )
+    if args.loss_chunk:
+        lm_loss_fn = lambda lg, lb, label_smoothing=0.0: lg.mean()  # noqa: E731
+    else:
+        lm_loss_fn = lambda lg, lb, label_smoothing=0.0: next_token_loss(lg, lb)  # noqa: E731
     step = build_train_step(
         mesh, state, compute_dtype=dtype,
-        loss_fn=lambda lg, lb, label_smoothing=0.0: next_token_loss(lg, lb),
+        loss_fn=lm_loss_fn,
         metrics_fn=lambda lg, lb, loss: {"loss": loss.astype(jnp.float32)},
     )
     rng = np.random.default_rng(0)
@@ -715,6 +729,12 @@ def main() -> int:
                         choices=("none", "full", "dots"),
                         help="encoder-layer rematerialization for bert-*")
     parser.add_argument("--model", default="resnet50")
+    parser.add_argument(
+        "--loss-chunk", type=int, default=None,
+        help="lm only: fuse the head matmul into a chunked CE so the full "
+        "[b,s,vocab] f32 logits never materialize (seq-64k memory lever); "
+        "must divide seq_len-1",
+    )
     parser.add_argument("--num-iters", type=int, default=5)
     parser.add_argument("--num-batches-per-iter", type=int, default=20)
     parser.add_argument("--num-warmup", type=int, default=10)

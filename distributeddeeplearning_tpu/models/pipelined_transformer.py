@@ -23,7 +23,7 @@ model-level consumer of the framework's ``pipe`` mesh axis.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -246,6 +246,71 @@ def forward_pipelined(
         remat=remat,
     )
     return x @ params["head"]
+
+
+def per_token_loss(
+    params,
+    tokens: jax.Array,
+    *,
+    num_heads: int,
+    attention: str = "dense",
+    attention_fn=None,
+    remat: bool = False,
+    loss_chunk: Optional[int] = None,
+) -> jax.Array:
+    """Per-position next-token CE ``[b, s-1]`` WITHOUT the full logits.
+
+    At long context the ``[b, s, vocab]`` f32 logits tensor is itself the
+    memory wall (seq 64k × vocab 32k = 8.6 GB f32 — more than half a v5e's
+    HBM before any activation).  This fuses the head matmul into the loss:
+    a ``lax.scan`` over ``loss_chunk``-sized sequence chunks computes each
+    chunk's logits, logsumexp and target gather, keeping peak logits
+    memory O(chunk × vocab).  The chunk body is ``jax.checkpoint``-ed so
+    backward RECOMPUTES chunk logits from the hidden states instead of
+    saving them (without that, scan's saved residuals re-materialize the
+    full logits and nothing is won).
+
+    Exact same math as ``next_token_loss(forward(...), tokens)`` (f32 CE);
+    ``loss_chunk=None`` falls back to the one-shot head matmul.
+    """
+    b, s = tokens.shape
+    if s < 2:
+        raise ValueError(
+            f"next-token loss needs sequence length >= 2, got {s}"
+        )
+    x = _embed(params, tokens)
+    x = _stack_scan(
+        params["blocks"], x, num_heads=num_heads, attention=attention,
+        attention_fn=attention_fn, remat=remat,
+    )
+    h = x[:, :-1]  # [b, s-1, d] — position t predicts token t+1
+    labels = tokens[:, 1:]
+    n = s - 1
+    head = params["head"]
+
+    def chunk_ce(hc, lc):
+        logits = (hc @ head).astype(jnp.float32)  # [b, c, V]
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
+        return lse - tgt
+
+    if loss_chunk is None or loss_chunk >= n:
+        return chunk_ce(h, labels)
+    if n % loss_chunk:
+        raise ValueError(
+            f"loss_chunk {loss_chunk} must divide seq_len-1 = {n}"
+        )
+    nch = n // loss_chunk
+    d = h.shape[-1]
+    h_c = h.reshape(b, nch, loss_chunk, d).swapaxes(0, 1)
+    lab_c = labels.reshape(b, nch, loss_chunk).swapaxes(0, 1)
+
+    def body(carry, xs):
+        hc, lc = xs
+        return carry, chunk_ce(hc, lc)
+
+    _, losses = jax.lax.scan(jax.checkpoint(body), None, (h_c, lab_c))
+    return losses.swapaxes(0, 1).reshape(b, n)
 
 
 def next_token_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:

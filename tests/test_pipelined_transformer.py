@@ -188,3 +188,56 @@ def test_remat_matches_no_remat():
     np.testing.assert_allclose(
         np.asarray(g0), np.asarray(g1), atol=1e-6, rtol=1e-5
     )
+
+
+@pytest.mark.parametrize("loss_chunk", [None, 5, 23])
+def test_per_token_loss_matches_full_logits(loss_chunk):
+    """The chunked head-matmul+CE (per_token_loss) must equal the one-shot
+    next_token_loss(forward(...)) in value and gradients — the fusion is a
+    memory transform, not a different loss."""
+    import jax.flatten_util
+
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        per_token_loss,
+    )
+
+    params = init_params(
+        jax.random.key(4), num_layers=2, d_model=32, num_heads=2, d_ff=64,
+        vocab_size=131, max_len=24,
+    )
+    toks = jnp.asarray(
+        np.random.default_rng(9).integers(0, 131, (2, 24)), jnp.int32
+    )  # s-1 = 23: chunk 23 = single chunk, chunk 5 would not divide -> use 23
+    if loss_chunk == 5:
+        toks = toks[:, :21]  # s-1 = 20, divisible by 5
+
+    def full(p):
+        return next_token_loss(forward(p, toks, num_heads=2), toks)
+
+    def chunked(p):
+        return per_token_loss(
+            p, toks, num_heads=2, loss_chunk=loss_chunk
+        ).mean()
+
+    np.testing.assert_allclose(
+        float(full(params)), float(chunked(params)), rtol=1e-6
+    )
+    g0, _ = jax.flatten_util.ravel_pytree(jax.grad(full)(params))
+    g1, _ = jax.flatten_util.ravel_pytree(jax.grad(chunked)(params))
+    np.testing.assert_allclose(
+        np.asarray(g0), np.asarray(g1), atol=1e-6, rtol=1e-5
+    )
+
+
+def test_per_token_loss_chunk_must_divide():
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        per_token_loss,
+    )
+
+    params = init_params(
+        jax.random.key(4), num_layers=2, d_model=32, num_heads=2, d_ff=64,
+        vocab_size=131, max_len=24,
+    )
+    toks = jnp.zeros((1, 24), jnp.int32)
+    with pytest.raises(ValueError, match="loss_chunk"):
+        per_token_loss(params, toks, num_heads=2, loss_chunk=7)
