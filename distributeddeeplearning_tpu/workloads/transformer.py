@@ -90,6 +90,10 @@ def main(
     # jax.checkpoint each pipeline tick (pipe>1, ops/pipeline.py) or each
     # layer of the sequential scan (pipe=1) — the long-context memory lever
     remat: bool = False,
+    # fuse head matmul + CE over sequence chunks so the [b,s,vocab] f32
+    # logits never materialize (models.per_token_loss; must divide
+    # seq_len-1).  top1 is unavailable in this mode (no logits exist).
+    loss_chunk: Optional[int] = None,
     # "flash" = causal Pallas kernel (long context, single shard);
     # "ring"/"ulysses" = causal sequence-parallel attention over --seq
     attention: str = "dense",
@@ -103,6 +107,7 @@ def main(
         forward_pipelined,
         init_params,
         next_token_loss,
+        per_token_loss,
     )
     from distributeddeeplearning_tpu.parallel import (
         MeshSpec,
@@ -145,6 +150,11 @@ def main(
         )
     if attention in _sp_modes and seq_len % max(seq, 1):
         raise ValueError(f"seq_len {seq_len} not divisible by seq axis {seq}")
+    if loss_chunk and pipe > 1:
+        raise ValueError(
+            "loss_chunk uses the sequential forward and cannot combine "
+            "with pipe > 1"
+        )
     ctx = initialize(force=distributed)
     mesh = create_mesh(MeshSpec(pipe=pipe, seq=seq), num_slices=num_slices)
     attention_fn = None
@@ -196,20 +206,27 @@ def main(
             else a,
             variables["params"],
         )
-        if pipe > 1:
-            logits = forward_pipelined(
+        if loss_chunk:
+            # "logits" are the per-position losses [b, s-1]; the full
+            # [b, s, vocab] f32 logits never materialize.
+            out = per_token_loss(
+                p, tokens, num_heads=num_heads, attention=attention,
+                attention_fn=attention_fn, remat=remat,
+                loss_chunk=loss_chunk,
+            )
+        elif pipe > 1:
+            out = forward_pipelined(
                 p, tokens, num_heads=num_heads, mesh=mesh,
                 num_microbatches=num_microbatches, remat=remat,
                 attention=attention,
-            )
+            ).astype(jnp.float32)
         else:
-            logits = forward(p, tokens, num_heads=num_heads,
-                             attention=attention, attention_fn=attention_fn,
-                             remat=remat)
-        logits = logits.astype(jnp.float32)
+            out = forward(p, tokens, num_heads=num_heads,
+                          attention=attention, attention_fn=attention_fn,
+                          remat=remat).astype(jnp.float32)
         if mutable is not None:
-            return logits, {}
-        return logits
+            return out, {}
+        return out
 
     schedule = warmup_linear_decay_schedule(
         base_lr, total_steps, warmup_fraction=warmup_fraction
@@ -239,19 +256,32 @@ def main(
         ),
     }
 
-    def lm_loss(logits, labels, *, label_smoothing: float = 0.0):
-        del label_smoothing  # the LM loss has no smoothing knob
-        return next_token_loss(logits, labels)
+    if loss_chunk:
+        # apply_fn already returned per-position losses; no logits exist,
+        # so top1 is structurally unavailable in this mode.
+        def lm_loss(losses, labels, *, label_smoothing: float = 0.0):
+            del label_smoothing
+            return losses.mean()
 
-    def lm_metrics(logits, tokens, loss):
-        b, s = tokens.shape
-        flat = logits[:, :-1].reshape(b * (s - 1), -1)
-        targets = tokens[:, 1:].reshape(b * (s - 1))
-        return {
-            "loss": loss.astype(jnp.float32),
-            "top1": topk_correct(flat, targets, 1),
-            "perplexity": jnp.exp(loss).astype(jnp.float32),
-        }
+        def lm_metrics(losses, tokens, loss):
+            return {
+                "loss": loss.astype(jnp.float32),
+                "perplexity": jnp.exp(loss).astype(jnp.float32),
+            }
+    else:
+        def lm_loss(logits, labels, *, label_smoothing: float = 0.0):
+            del label_smoothing  # the LM loss has no smoothing knob
+            return next_token_loss(logits, labels)
+
+        def lm_metrics(logits, tokens, loss):
+            b, s = tokens.shape
+            flat = logits[:, :-1].reshape(b * (s - 1), -1)
+            targets = tokens[:, 1:].reshape(b * (s - 1))
+            return {
+                "loss": loss.astype(jnp.float32),
+                "top1": topk_correct(flat, targets, 1),
+                "perplexity": jnp.exp(loss).astype(jnp.float32),
+            }
 
     train_step = build_train_step(
         mesh, state, schedule=schedule, compute_dtype=dtype,

@@ -103,3 +103,14 @@ def test_lm_seq_parallel_flag_validation():
         lm_main(attention="ring", seq=2, pipe=2, **TINY)
     with pytest.raises(ValueError, match="ring"):
         lm_main(attention="dense", seq=2, **TINY)
+
+
+def test_lm_loss_chunk_trains():
+    """--loss_chunk fuses head+CE (no logits materialize); trains end-to-end
+    with loss+perplexity metrics (top1 structurally unavailable)."""
+    state, fit = lm_main(loss_chunk=5, **TINY)  # seq_len 16 -> s-1 = 15
+    assert np.isfinite(fit.final_train_metrics["loss"])
+    assert "top1" not in fit.final_train_metrics
+    assert "perplexity" in fit.final_train_metrics
+    with pytest.raises(ValueError, match="loss_chunk"):
+        lm_main(loss_chunk=5, pipe=2, **TINY)
