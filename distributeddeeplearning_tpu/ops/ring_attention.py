@@ -9,6 +9,14 @@ over the passing blocks with the online-softmax (flash-attention) recurrence
 overlaps with the matmul of the current one (XLA schedules the ppermute
 concurrently with compute).
 
+The O(S/n) claim holds in TRAINING, not just forward: the op carries a
+``jax.custom_vjp`` whose backward RE-ROTATES k/v around the ring a second
+time (recomputing each tick's probabilities from the saved logsumexp, with
+dk/dv accumulators travelling alongside their blocks) instead of letting
+``lax.scan``'s reverse-mode save every tick's rotated carry — which would
+silently materialize all ``ring × [B, S/n, H, D]`` k/v blocks per device,
+i.e. a full [B, S, H, D] gather, defeating the point of the ring.
+
 This is the TPU-native shape of Ring Attention (Liu et al. 2310.01889,
 blockwise parallel transformers): collectives are compiled by XLA onto the
 ICI ring — no NCCL/MPI, no host involvement.  The reference framework has no
@@ -34,21 +42,21 @@ from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
 _NEG_BIG = -1e30  # finite mask fill; -inf poisons the online-softmax max
 
 
-def _online_update(q, k, v, mask_blk, m, l, o, scale):
+def _online_update(q, k, v, bias_blk, m, l, o, scale):
     """One online-softmax accumulation of a k/v block into (m, l, o).
 
-    q ``[B, Sq, H, D]``; k, v ``[B, Sk, H, D]``; mask_blk broadcastable to
-    ``[B, 1, Sq, Sk]`` (``[B, 1, 1, Sk]`` key-padding only, the extra Sq
-    dim when the causal triangle is folded in); m, l ``[B, H, Sq]`` f32;
-    o ``[B, Sq, H, D]`` f32.  The same recurrence serves both loops of the
-    ring: over ring ticks (device-sized blocks) and, when ``block_k`` is
-    set, over sub-blocks within a tick.
+    q ``[B, Sq, H, D]``; k, v ``[B, Sk, H, D]``; bias_blk f32 broadcastable
+    to ``[B, 1, Sq, Sk]`` (0 = attend, ``_NEG_BIG`` = masked — key padding
+    and, in causal mode, the folded-in global-position triangle); m, l
+    ``[B, H, Sq]`` f32; o ``[B, Sq, H, D]`` f32.  The same recurrence
+    serves both loops of the ring: over ring ticks (device-sized blocks)
+    and, when ``block_k`` is set, over sub-blocks within a tick.
     """
     scores = (
         jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
         * scale
+        + bias_blk
     )
-    scores = jnp.where(mask_blk, scores, _NEG_BIG)
     m_new = jnp.maximum(m, scores.max(axis=-1))
     p = jnp.exp(scores - m_new[..., None])
     correction = jnp.exp(m - m_new)
@@ -59,21 +67,33 @@ def _online_update(q, k, v, mask_blk, m, l, o, scale):
     return m_new, l, o
 
 
-def _ring_body(q, k, v, mask, *, axis_name: str, ring: int, out_dtype,
-               block_k: Optional[int] = None, causal: bool = False):
-    """Per-shard blockwise attention with rotating k/v (runs in shard_map).
+def _slice_bias(bias_all, start, width, q_pos, k0, causal):
+    """Bias tile for keys at global positions ``k0 + [0, width)``.
+
+    ``bias_all`` f32 ``[B, 1, 1, S]`` (key padding); adds the causal
+    triangle in GLOBAL coordinates when asked — broadcast result is
+    ``[B, 1, Sq, width]`` (or ``[B, 1, 1, width]`` without causal).
+    """
+    tile = jax.lax.dynamic_slice_in_dim(bias_all, start, width, axis=3)
+    if causal:
+        k_pos = k0 + jnp.arange(width, dtype=jnp.int32)
+        tri = jnp.where(
+            q_pos[:, None] >= k_pos[None, :], 0.0, _NEG_BIG
+        ).astype(jnp.float32)
+        tile = tile + tri[None, None]
+    return tile
+
+
+def _ring_fwd(q, k, v, bias_all, *, axis_name, ring, block_k, causal):
+    """Forward ring pass (runs in shard_map); returns (o_norm f32, lse).
 
     Shapes (local shard): q ``[B, Sq, H, D]``; k, v ``[B, Skv, H, D]``;
-    mask ``[B, 1, 1, Skv]`` bool (True = attend).  The ring is a
+    bias_all f32 ``[B, 1, 1, S]`` (0 / _NEG_BIG key-padding bias, gathered
+    once as the replacement for a third rotating buffer).  The ring is a
     ``lax.scan`` over the rotation count — program size and compile time
     are CONSTANT in the ring size (a pod-scale seq axis of 16 compiles the
-    same one-block body as a ring of 2), and every iteration is
-    reverse-mode differentiable.  XLA overlaps each block's ppermute with
-    the previous block's matmuls.
-
-    Only k/v rotate.  The key-padding mask is all-gathered ONCE (bool
-    ``[B, 1, 1, S]`` — bits, not activations) and indexed by each step's
-    source rank, replacing a third per-step ppermute buffer.
+    same one-block body as a ring of 2).  XLA overlaps each block's
+    ppermute with the previous block's matmuls.
 
     ``block_k`` bounds the materialized score tile: the tick's Skv keys are
     consumed in an INNER scan of ``block_k``-sized chunks through the same
@@ -84,7 +104,7 @@ def _ring_body(q, k, v, mask, *, axis_name: str, ring: int, out_dtype,
 
     ``causal`` applies the autoregressive triangle in GLOBAL positions:
     this shard's queries live at ``rank·Sq + [0, Sq)`` and the tick's keys
-    at ``src·Skv + [0, Skv)``, so each tick's mask is full (src < rank),
+    at ``src·Skv + [0, Skv)``, so each tick's bias is full (src < rank),
     triangular (src == rank) or empty (src > rank).  Fully-dead work is
     SKIPPED, not just masked: a ``lax.cond`` wraps the online update at
     both the tick and the ``block_k``-chunk level (live iff the last query
@@ -92,99 +112,77 @@ def _ring_body(q, k, v, mask, *, axis_name: str, ring: int, out_dtype,
     its ppermute — the ring-level analogue of the flash kernel's
     masked-tile skip.  The cond is legal because the rotation collectives
     sit outside it, keeping the scan body collective-uniform across
-    devices.  Masking is exact either way; the lockstep critical path
-    still runs all ``n`` ticks (at every tick some device owns a live
-    block) — a load-balanced striped layout is the known further
-    optimization and would change the data contract.
+    devices.  The lockstep critical path still runs all ``n`` ticks (at
+    every tick some device owns a live block) — a load-balanced striped
+    layout is the known further optimization and would change the data
+    contract.
     """
     depth = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(depth, jnp.float32))
     b, sq, h, _ = q.shape
     skv = k.shape[1]
-    if block_k is not None and (block_k <= 0 or skv % block_k):
-        raise ValueError(
-            f"block_k {block_k} must divide the local kv length {skv}"
-        )
 
     m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, sq), jnp.float32)
     o0 = jnp.zeros(q.shape, jnp.float32)
     perm = [(j, (j + 1) % ring) for j in range(ring)]
     rank = jax.lax.axis_index(axis_name)
-    mask_all = jax.lax.all_gather(
-        mask, axis_name, axis=3, tiled=True
-    )  # [B, 1, 1, S]
     # Global positions of this shard's queries — the causal triangle is in
-    # GLOBAL coordinates, so each tick compares them to the source block's
-    # global key positions ([sq] / [skv] i32; tiny next to the activations).
+    # GLOBAL coordinates ([sq] i32; tiny next to the activations).
     q_pos = rank * sq + jnp.arange(sq, dtype=jnp.int32)
 
     def step_fn(carry, r):
         k, v, m, l, o = carry
         # after r rotations this device holds the block that started on
-        # rank (rank - r) mod ring; slice that block's key-padding mask
+        # rank (rank - r) mod ring; slice that block's key-padding bias
         src = jax.lax.rem(rank - r + ring, ring)
-        mask_r = jax.lax.dynamic_slice_in_dim(mask_all, src * skv, skv, axis=3)
         if block_k is None or block_k >= skv:
+            bias = _slice_bias(bias_all, src * skv, skv, q_pos,
+                               src * skv, causal)
             if causal:
-                k_pos = src * skv + jnp.arange(skv, dtype=jnp.int32)
-                # [B,1,1,Skv] & [1,1,Sq,Skv] -> [B,1,Sq,Skv]
-                mask_c = jnp.logical_and(
-                    mask_r, (q_pos[:, None] >= k_pos[None, :])[None, None]
-                )
-                # Skip the tick's matmuls when every (q, k) pair is
-                # future-masked: live iff the LAST query can see the FIRST
-                # key.  The rotation below stays outside the cond.
                 m, l, o = jax.lax.cond(
                     q_pos[-1] >= src * skv,
                     lambda m, l, o: _online_update(
-                        q, k, v, mask_c, m, l, o, scale
+                        q, k, v, bias, m, l, o, scale
                     ),
                     lambda m, l, o: (m, l, o),
                     m, l, o,
                 )
             else:
-                m, l, o = _online_update(q, k, v, mask_r, m, l, o, scale)
+                m, l, o = _online_update(q, k, v, bias, m, l, o, scale)
         else:
             nchunks = skv // block_k
             # [nchunks, B, block_k, H, D] — leading scan axis
             k_c = k.reshape(b, nchunks, block_k, h, depth).swapaxes(0, 1)
             v_c = v.reshape(b, nchunks, block_k, h, depth).swapaxes(0, 1)
-            mask_c = mask_r.reshape(b, 1, 1, nchunks, block_k).transpose(
-                3, 0, 1, 2, 4
-            )
 
             def chunk_fn(inner, xs):
                 im, il, io = inner
-                kc, vc, mc, c = xs
+                kc, vc, c = xs
+                k0 = src * skv + c * block_k
+                bias_c = _slice_bias(bias_all, k0, block_k, q_pos, k0, causal)
                 if causal:
-                    # chunk keys at global src*Skv + c*block_k + [0, block_k)
-                    k0 = src * skv + c * block_k
-                    kc_pos = k0 + jnp.arange(block_k, dtype=jnp.int32)
-                    mcc = jnp.logical_and(
-                        mc, (q_pos[:, None] >= kc_pos[None, :])[None, None]
-                    )
-                    # Fully-future chunks skip their matmuls (see tick-level
-                    # cond above); no collectives inside the inner scan, so
-                    # the branch is unconditionally legal.
+                    # Fully-future chunks skip their matmuls (see the
+                    # tick-level cond); no collectives inside the inner
+                    # scan, so the branch is unconditionally legal.
                     im, il, io = jax.lax.cond(
                         q_pos[-1] >= k0,
                         lambda im, il, io: _online_update(
-                            q, kc, vc, mcc, im, il, io, scale
+                            q, kc, vc, bias_c, im, il, io, scale
                         ),
                         lambda im, il, io: (im, il, io),
                         im, il, io,
                     )
                 else:
                     im, il, io = _online_update(
-                        q, kc, vc, mc, im, il, io, scale
+                        q, kc, vc, bias_c, im, il, io, scale
                     )
                 return (im, il, io), None
 
             (m, l, o), _ = jax.lax.scan(
                 chunk_fn,
                 (m, l, o),
-                (k_c, v_c, mask_c, jnp.arange(nchunks, dtype=jnp.int32)),
+                (k_c, v_c, jnp.arange(nchunks, dtype=jnp.int32)),
             )
         # Unconditional rotation (uniform scan body; the final one returns
         # k/v to their home shard, so the op leaves no residual rotation).
@@ -198,7 +196,183 @@ def _ring_body(q, k, v, mask, *, axis_name: str, ring: int, out_dtype,
 
     l = jnp.maximum(l, 1e-30)  # fully-masked rows (all-padding) stay finite
     o = o / l.transpose(0, 2, 1)[..., None]
-    return o.astype(out_dtype)
+    lse = m + jnp.log(l)  # [B, H, Sq]
+    return o, lse
+
+
+def _ring_bwd(q, k, v, bias_all, o, lse, do, *, axis_name, ring, block_k,
+              causal):
+    """Backward ring pass: a SECOND rotation of k/v with dk/dv riding along.
+
+    FlashAttention-style backward per tick: probabilities are recomputed
+    from the saved ``lse`` (p = exp(q·kᵀ·scale + bias − lse)), then
+
+        dv += pᵀ · do
+        ds  = p ⊙ (do · vᵀ − Δ) · scale,   Δ = rowsum(do ⊙ o)
+        dq += ds · k
+        dk += dsᵀ · q
+
+    dk/dv accumulate on whichever device currently HOLDS their k/v block
+    and rotate with it — after ``ring`` ticks every gradient block is home,
+    so no gather and no per-tick residuals: backward memory is O(Sq·Skv)
+    (O(Sq·block_k) blocked), matching forward.  ``causal`` reuses the
+    fwd's global-position bias and the same dead-tick/chunk cond skip.
+    """
+    depth = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(depth, jnp.float32))
+    b, sq, h, _ = q.shape
+    skv = k.shape[1]
+    perm = [(j, (j + 1) % ring) for j in range(ring)]
+    rank = jax.lax.axis_index(axis_name)
+    q_pos = rank * sq + jnp.arange(sq, dtype=jnp.int32)
+
+    do = do.astype(jnp.float32)
+    # Δ [B, H, Sq]: rowsum of do ⊙ o (both [B, Sq, H, D] f32)
+    delta = jnp.einsum("bqhd,bqhd->bhq", do, o.astype(jnp.float32))
+
+    def tile_grads(kc, vc, bias_c):
+        """(dq_tile, dk_tile, dv_tile) for one k/v tile against all of q."""
+        s = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk", q, kc,
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+            + bias_c
+        )
+        p = jnp.exp(s - lse[..., None])  # [B, H, Sq, Kt]
+        dv_t = jnp.einsum("bhqk,bqhd->bkhd", p, do)
+        dp = jnp.einsum(
+            "bqhd,bkhd->bhqk", do, vc.astype(jnp.float32)
+        )
+        ds = p * (dp - delta[..., None]) * scale
+        dq_t = jnp.einsum("bhqk,bkhd->bqhd", ds, kc.astype(jnp.float32))
+        dk_t = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32))
+        return dq_t, dk_t, dv_t
+
+    def step_fn(carry, r):
+        k, v, dk, dv, dq = carry
+        src = jax.lax.rem(rank - r + ring, ring)
+        if block_k is None or block_k >= skv:
+            bias = _slice_bias(bias_all, src * skv, skv, q_pos,
+                               src * skv, causal)
+            if causal:
+                dq_t, dk_t, dv_t = jax.lax.cond(
+                    q_pos[-1] >= src * skv,
+                    lambda: tile_grads(k, v, bias),
+                    lambda: (
+                        jnp.zeros_like(dq),
+                        jnp.zeros(k.shape, jnp.float32),
+                        jnp.zeros(v.shape, jnp.float32),
+                    ),
+                )
+            else:
+                dq_t, dk_t, dv_t = tile_grads(k, v, bias)
+            dq = dq + dq_t
+            dk = dk + dk_t
+            dv = dv + dv_t
+        else:
+            nchunks = skv // block_k
+            k_c = k.reshape(b, nchunks, block_k, h, depth).swapaxes(0, 1)
+            v_c = v.reshape(b, nchunks, block_k, h, depth).swapaxes(0, 1)
+
+            def chunk_fn(dq_acc, xs):
+                kc, vc, c = xs
+                k0 = src * skv + c * block_k
+                bias_c = _slice_bias(bias_all, k0, block_k, q_pos, k0, causal)
+                if causal:
+                    dq_t, dk_t, dv_t = jax.lax.cond(
+                        q_pos[-1] >= k0,
+                        lambda: tile_grads(kc, vc, bias_c),
+                        lambda: (
+                            jnp.zeros_like(dq_acc),
+                            jnp.zeros(kc.shape, jnp.float32),
+                            jnp.zeros(vc.shape, jnp.float32),
+                        ),
+                    )
+                else:
+                    dq_t, dk_t, dv_t = tile_grads(kc, vc, bias_c)
+                return dq_acc + dq_t, (dk_t, dv_t)
+
+            dq, (dk_st, dv_st) = jax.lax.scan(
+                chunk_fn, dq,
+                (k_c, v_c, jnp.arange(nchunks, dtype=jnp.int32)),
+            )
+            dk = dk + dk_st.swapaxes(0, 1).reshape(b, skv, h, depth)
+            dv = dv + dv_st.swapaxes(0, 1).reshape(b, skv, h, depth)
+        # dk/dv rotate WITH their block so they arrive home together.
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        dk = jax.lax.ppermute(dk, axis_name, perm)
+        dv = jax.lax.ppermute(dv, axis_name, perm)
+        return (k, v, dk, dv, dq), None
+
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros_like(dk0)
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    (_, _, dk, dv, dq), _ = jax.lax.scan(
+        step_fn, (k, v, dk0, dv0, dq0), jnp.arange(ring)
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _make_ring_core(*, axis_name: str, ring: int,
+                    block_k: Optional[int], causal: bool):
+    """custom_vjp ring attention over local shards (called inside shard_map).
+
+    Differentiable in (q, k, v); ``bias_all`` (the gathered f32 key-padding
+    bias) gets a zero cotangent — it derives from a bool mask upstream.
+    """
+
+    @jax.custom_vjp
+    def core(q, k, v, bias_all):
+        o, _ = _ring_fwd(
+            q, k, v, bias_all, axis_name=axis_name, ring=ring,
+            block_k=block_k, causal=causal,
+        )
+        return o
+
+    def fwd(q, k, v, bias_all):
+        o, lse = _ring_fwd(
+            q, k, v, bias_all, axis_name=axis_name, ring=ring,
+            block_k=block_k, causal=causal,
+        )
+        return o, (q, k, v, bias_all, o, lse)
+
+    def bwd(res, do):
+        q, k, v, bias_all, o, lse = res
+        dq, dk, dv = _ring_bwd(
+            q, k, v, bias_all, o, lse, do, axis_name=axis_name, ring=ring,
+            block_k=block_k, causal=causal,
+        )
+        return dq, dk, dv, jnp.zeros_like(bias_all)
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
+def _ring_body(q, k, v, mask, *, axis_name: str, ring: int, out_dtype,
+               block_k: Optional[int] = None, causal: bool = False):
+    """Per-shard entry (runs in shard_map): mask → bias, then the vjp core.
+
+    Only k/v rotate.  The key-padding mask is all-gathered ONCE (bool
+    ``[B, 1, 1, S]`` — bits, not activations) and converted to a 0/_NEG_BIG
+    f32 bias indexed by each tick's source rank, replacing a third per-step
+    ppermute buffer.
+    """
+    skv = k.shape[1]
+    if block_k is not None and (block_k <= 0 or skv % block_k):
+        raise ValueError(
+            f"block_k {block_k} must divide the local kv length {skv}"
+        )
+    mask_all = jax.lax.all_gather(
+        mask, axis_name, axis=3, tiled=True
+    )  # bool [B, 1, 1, S]
+    bias_all = jnp.where(mask_all, 0.0, _NEG_BIG).astype(jnp.float32)
+    core = _make_ring_core(
+        axis_name=axis_name, ring=ring, block_k=block_k, causal=causal
+    )
+    return core(q, k, v, bias_all).astype(out_dtype)
 
 
 def ring_attention(
@@ -220,12 +394,16 @@ def ring_attention(
     axes, sequence over ``seq``); output has the same layout.
 
     ``block_k`` enables the flash-style blocked inner loop (see
-    ``_ring_body``): per-device score memory O(Sq·block_k) instead of
+    ``_ring_fwd``): per-device score memory O(Sq·block_k) instead of
     O(S²/n²) per tick — required once S/n alone is big (seq-64k over 8
     chips = 8k×8k f32 scores/tick/head unblocked).
 
     ``causal=True`` applies the autoregressive triangle in global
-    positions (see ``_ring_body``) — the sequence-parallel decoder path.
+    positions (see ``_ring_fwd``) — the sequence-parallel decoder path.
+
+    Training memory is O(S/n) per device in BOTH directions: the custom
+    backward re-rotates k/v instead of saving per-tick scan residuals
+    (see ``_ring_bwd``).
     """
     from distributeddeeplearning_tpu.parallel.compat import shard_map
 

@@ -311,3 +311,29 @@ def test_causal_seq_axis_one_falls_back_to_dense(qkv, padding_mask):
     np.testing.assert_allclose(
         np.asarray(ring), np.asarray(dense), atol=1e-6
     )
+
+
+def test_backward_rerotates_instead_of_saving_ticks():
+    """Training-memory contract: the custom backward re-rotates k/v (4
+    ppermutes per bwd tick: k, v, dk, dv) instead of letting scan AD stack
+    per-tick k/v residuals.  The grad jaxpr must contain exactly the fwd
+    scan's 2 ppermute sites plus the bwd scan's 4 — constant in ring size —
+    and no [ring, ...]-stacked k/v residual output from the forward scan."""
+    mesh = create_mesh(MeshSpec(seq=8))
+    b, s, h, d = 2, 64, 2, 8
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+
+    def loss(q):
+        return (
+            ring_attention(
+                q, q, q, None, mesh=mesh, dtype=jnp.float32, causal=True
+            )
+            ** 2
+        ).sum()
+
+    jaxpr = str(jax.make_jaxpr(jax.grad(loss))(q))
+    assert jaxpr.count("ppermute") == 6, jaxpr.count("ppermute")
+    # scan-AD residual stacking would show as a fwd-scan output of shape
+    # [ring=8, b, skv=s/8, h, d] = f32[8,2,8,2,8]
+    assert "f32[8,2,8,2,8]" not in jaxpr
