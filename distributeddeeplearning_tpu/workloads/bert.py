@@ -105,7 +105,10 @@ def main(
     tensor: int = 1,
     seq: int = 1,
     expert: int = 1,
-    attention: str = "auto",  # auto|default|flash|ring|ulysses
+    attention: str = "auto",  # auto|default|flash|ring|ulysses|ulysses-flash
+    # ring attention's blocked inner loop: bounds per-tick score memory at
+    # O(Sq*block_k) — set for long-context launches (must divide S/seq)
+    sp_block_k: Optional[int] = None,
     remat: str = "none",  # none|full|dots — encoder-layer rematerialization
     num_experts: int = 0,  # >0 = MoE FFN in every 2nd layer (models/moe.py)
     # model-size overrides (tiny configs for tests/smoke)
@@ -204,7 +207,9 @@ def main(
             f"'ulysses-flash', got {attention!r}"
         )
     if attention == "ring":
-        model_kwargs["attention_fn"] = make_ring_attention(mesh)
+        model_kwargs["attention_fn"] = make_ring_attention(
+            mesh, block_k=sp_block_k
+        )
     elif attention in ("ulysses", "ulysses-flash"):
         from distributeddeeplearning_tpu.ops import make_ulysses_attention
 

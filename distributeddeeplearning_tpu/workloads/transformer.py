@@ -97,6 +97,9 @@ def main(
     # "flash" = causal Pallas kernel (long context, single shard);
     # "ring"/"ulysses" = causal sequence-parallel attention over --seq
     attention: str = "dense",
+    # ring attention's blocked inner loop: bounds per-tick score memory at
+    # O(Sq*block_k) — set for long-context launches (must divide S/seq)
+    sp_block_k: Optional[int] = None,
 ):
     """Train; returns (state, FitResult)."""
     import jax
@@ -161,7 +164,9 @@ def main(
     if attention == "ring":
         from distributeddeeplearning_tpu.ops import make_ring_attention
 
-        attention_fn = make_ring_attention(mesh, causal=True)
+        attention_fn = make_ring_attention(
+            mesh, causal=True, block_k=sp_block_k
+        )
     elif attention in ("ulysses", "ulysses-flash"):
         from distributeddeeplearning_tpu.ops import make_ulysses_attention
 

@@ -114,3 +114,9 @@ def test_lm_loss_chunk_trains():
     assert "perplexity" in fit.final_train_metrics
     with pytest.raises(ValueError, match="loss_chunk"):
         lm_main(loss_chunk=5, pipe=2, **TINY)
+
+
+def test_lm_ring_block_k_trains():
+    """--sp_block_k engages the ring's blocked inner loop end-to-end."""
+    state, fit = lm_main(attention="ring", seq=2, sp_block_k=4, **TINY)
+    assert np.isfinite(fit.final_train_metrics["loss"])
