@@ -120,3 +120,14 @@ def test_lm_ring_block_k_trains():
     """--sp_block_k engages the ring's blocked inner loop end-to-end."""
     state, fit = lm_main(attention="ring", seq=2, sp_block_k=4, **TINY)
     assert np.isfinite(fit.final_train_metrics["loss"])
+
+
+def test_lm_all_levers_compose():
+    """The flagship long-context composition: causal ring attention (seq
+    axis) + per-layer remat + chunked head+CE, all in one training run."""
+    state, fit = lm_main(
+        attention="ring", seq=2, sp_block_k=4, remat=True, loss_chunk=5,
+        **TINY,
+    )
+    assert np.isfinite(fit.final_train_metrics["loss"])
+    assert "perplexity" in fit.final_train_metrics
