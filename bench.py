@@ -375,6 +375,7 @@ def _run_single(args) -> int:
 
     is_bert = args.model.startswith("bert")
     is_lm = args.model == "lm"
+    is_vit = args.model.startswith("vit")
     if is_lm:
         metric = (
             f"lm_causal_{args.attention}_seq{args.seq_len}"
@@ -395,9 +396,9 @@ def _run_single(args) -> int:
         "value": value,
         "unit": unit,
         # The V100 yardstick is a ResNet-50 image-throughput figure; for the
-        # BERT/LM modes there is no comparable published baseline, so the
-        # field is null rather than a bogus cross-model ratio.
-        "vs_baseline": None if (is_bert or is_lm) else round(
+        # BERT/LM/ViT modes there is no comparable published baseline, so
+        # the field is null rather than a bogus cross-model ratio.
+        "vs_baseline": None if (is_bert or is_lm or is_vit) else round(
             result.img_sec_per_chip_mean / V100_TF_CNN_BENCHMARKS_IMG_SEC, 3
         ),
     }

@@ -27,7 +27,7 @@ def get_model(name: str, **kwargs):
     """Instantiate a model by name — parity with the reference's
     ``models.__dict__[args.model]()`` (``pytorch_synthetic_benchmark.py:60``)."""
     # import for registration side effects
-    from distributeddeeplearning_tpu.models import resnet, inception, bert, vgg  # noqa: F401
+    from distributeddeeplearning_tpu.models import resnet, inception, bert, vgg, vit  # noqa: F401
 
     key = name.lower()
     if key not in _REGISTRY:
@@ -36,6 +36,6 @@ def get_model(name: str, **kwargs):
 
 
 def available_models():
-    from distributeddeeplearning_tpu.models import resnet, inception, bert, vgg  # noqa: F401
+    from distributeddeeplearning_tpu.models import resnet, inception, bert, vgg, vit  # noqa: F401
 
     return sorted(_REGISTRY)

@@ -1,0 +1,198 @@
+"""Vision Transformer — beyond-parity image classifier on the MXU.
+
+The reference's vision stack is CNN-only (ResNet/Inception/VGG/AlexNet via
+tf_cnn_benchmarks — SURVEY.md §2 16a/16d); this adds the patch-transformer
+family the same framework surface serves everywhere else: the encoder block
+machinery is shared with :mod:`models.bert` (``SelfAttention``, logically
+partitioned dense layers), so every parallelism rule set (DP/FSDP/TP) and
+injectable attention primitive (flash, ring, Ulysses) applies to ViT
+unchanged.  ViT is the MXU-friendliest model in the zoo — its FLOPs are
+almost entirely large dense matmuls, so it benches the framework's compute
+ceiling where ResNet benches the HBM roofline.
+
+Architecture (An Image is Worth 16x16 Words, Dosovitskiy et al.
+2010.11929): conv patch embedding, prepended CLS token, learned position
+embeddings, PRE-LN encoder blocks (unlike BERT's post-LN), final LayerNorm,
+linear head on CLS.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.models import register
+from distributeddeeplearning_tpu.models.bert import (
+    AttentionFn,
+    BertConfig,
+    SelfAttention,
+    _dense,
+    dot_product_attention,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    num_classes: int = 1001  # background class 0, like the CNN zoo
+    dropout_rate: float = 0.0
+    layer_norm_eps: float = 1e-6
+    remat: str = "none"  # none|full|dots — per-block jax.checkpoint
+
+
+VIT_B16 = ViTConfig()
+VIT_L16 = ViTConfig(
+    hidden_size=1024, num_layers=24, num_heads=16, intermediate_size=4096
+)
+
+
+class ViTBlock(nn.Module):
+    """Pre-LN transformer block: x + Attn(LN(x)); x + MLP(LN(x))."""
+
+    config: ViTConfig
+    dtype: jnp.dtype = jnp.bfloat16
+    attention_fn: AttentionFn = dot_product_attention
+
+    @nn.compact
+    def __call__(self, x, mask, train: bool):
+        cfg = self.config
+        # SelfAttention only reads hidden_size/num_heads off its config —
+        # reuse bert's module with a shim so the qkv/out projections carry
+        # the same logical axes (and therefore the same sharding rules).
+        acfg = BertConfig(
+            hidden_size=cfg.hidden_size, num_heads=cfg.num_heads,
+            dropout_rate=cfg.dropout_rate,
+        )
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="attention_ln")(x)
+        h = SelfAttention(acfg, self.dtype, self.attention_fn,
+                          name="attention")(h, mask, train)
+        if cfg.dropout_rate:
+            h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
+        x = x + h
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="mlp_ln")(x)
+        h = _dense(cfg.intermediate_size, ("embed", "mlp"), self.dtype,
+                   "mlp_in")(h)
+        h = nn.gelu(h, approximate=False)
+        h = _dense(cfg.hidden_size, ("mlp", "embed"), self.dtype,
+                   "mlp_out")(h)
+        if cfg.dropout_rate:
+            h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
+        x = x + h
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class VisionTransformer(nn.Module):
+    """[B, H, W, 3] float images → [B, num_classes] f32 logits."""
+
+    config: ViTConfig = VIT_B16
+    dtype: jnp.dtype = jnp.bfloat16
+    attention_fn: AttentionFn = dot_product_attention
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        cfg = self.config
+        b, h, w, _ = images.shape
+        p = cfg.patch_size
+        if h % p or w % p:
+            raise ValueError(
+                f"image {h}x{w} not divisible by patch size {p}"
+            )
+        x = nn.Conv(
+            cfg.hidden_size,
+            (p, p),
+            strides=(p, p),
+            padding="VALID",
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), (None, None, None, "embed")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed",)
+            ),
+            name="patch_embed",
+        )(images.astype(self.dtype))
+        x = x.reshape(b, -1, cfg.hidden_size)  # [B, N, D]
+        n = x.shape[1]
+
+        cls = self.param(
+            "cls",
+            nn.with_logical_partitioning(nn.initializers.zeros,
+                                         (None, None, "embed")),
+            (1, 1, cfg.hidden_size),
+            jnp.float32,
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(self.dtype),
+                              (b, 1, cfg.hidden_size)), x],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), (None, None, "embed")
+            ),
+            (1, n + 1, cfg.hidden_size),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        if cfg.dropout_rate:
+            x = nn.Dropout(cfg.dropout_rate, deterministic=not train)(x)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        block_cls = ViTBlock
+        if cfg.remat != "none":
+            if cfg.remat == "full":
+                policy = None
+            elif cfg.remat == "dots":
+                policy = jax.checkpoint_policies.checkpoint_dots
+            else:
+                raise ValueError(
+                    f"remat must be 'none', 'full' or 'dots', got {cfg.remat!r}"
+                )
+            block_cls = nn.remat(ViTBlock, static_argnums=(3,), policy=policy)
+        for i in range(cfg.num_layers):
+            x = block_cls(
+                cfg, self.dtype, self.attention_fn, name=f"block{i}"
+            )(x, None, train)
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="final_ln")(x)
+        logits = nn.Dense(
+            cfg.num_classes, dtype=self.dtype, param_dtype=jnp.float32,
+            name="head",
+        )(x[:, 0])
+        return logits.astype(jnp.float32)
+
+
+def _make(base: ViTConfig, **kwargs):
+    cfg_kwargs = {
+        f.name: kwargs.pop(f.name)
+        for f in dataclasses.fields(ViTConfig)
+        if f.name in kwargs
+    }
+    cfg = dataclasses.replace(base, **cfg_kwargs)
+    return VisionTransformer(config=cfg, **kwargs)
+
+
+@register("vit-b16")
+@register("vit_b16")
+def vit_b16(**kwargs):
+    return _make(VIT_B16, **kwargs)
+
+
+@register("vit-l16")
+@register("vit_l16")
+def vit_l16(**kwargs):
+    return _make(VIT_L16, **kwargs)

@@ -1,0 +1,113 @@
+"""Vision Transformer (models/vit.py): shapes, training step, sharding.
+
+Beyond-parity model family — the encoder machinery (SelfAttention, logical
+axes) is shared with bert, so the same rule sets must shard it."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributeddeeplearning_tpu.models import get_model
+from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh, shard_batch
+from distributeddeeplearning_tpu.parallel.sharding import (
+    RULES_TP,
+    model_logical_axes,
+)
+from distributeddeeplearning_tpu.train.state import create_train_state
+from distributeddeeplearning_tpu.train.step import build_train_step
+
+TINY = dict(
+    image_size=32, patch_size=8, hidden_size=32, num_layers=2, num_heads=2,
+    intermediate_size=64, num_classes=11, dtype=jnp.float32,
+)
+
+
+def test_forward_shape_and_dtype():
+    model = get_model("vit-b16", **TINY)
+    imgs = jnp.ones((2, 32, 32, 3), jnp.float32)
+    params = model.init(jax.random.key(0), imgs, train=False)
+    out = model.apply(params, imgs, train=False)
+    assert out.shape == (2, 11)
+    assert out.dtype == jnp.float32
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_patch_divisibility_rejected():
+    model = get_model("vit-b16", **dict(TINY, patch_size=7))
+    with pytest.raises(ValueError, match="divisible"):
+        model.init(jax.random.key(0), jnp.ones((1, 32, 32, 3)), train=False)
+
+
+def test_registry_has_both_sizes():
+    big = get_model("vit-l16", **dict(TINY, num_layers=1))
+    assert big.config.intermediate_size == 64  # override applied
+    assert get_model("vit_b16", **TINY).config.patch_size == 8
+
+
+def test_dp_training_reduces_loss():
+    mesh = create_mesh(MeshSpec())
+    model = get_model("vit-b16", **TINY)
+    tx = optax.adam(1e-3)
+    state = create_train_state(
+        jax.random.key(0), model, (8, 32, 32, 3), tx
+    )
+    step = build_train_step(mesh, state, compute_dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    batch = shard_batch(
+        mesh,
+        {
+            "image": rng.standard_normal((8, 32, 32, 3)).astype(np.float32),
+            "label": rng.integers(0, 11, (8,)).astype(np.int32),
+        },
+    )
+    losses = []
+    for _ in range(4):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(v) for v in losses), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_tp_sharded_step_runs():
+    """The bert TP rules shard ViT's qkv/mlp (shared logical axes)."""
+    mesh = create_mesh(MeshSpec(tensor=2))
+    model = get_model("vit-b16", **TINY)
+    tx = optax.sgd(0.1)
+    axes = model_logical_axes(
+        model, jax.random.key(0), np.zeros((8, 32, 32, 3), np.float32),
+        train=False,
+    )
+    state = create_train_state(jax.random.key(0), model, (8, 32, 32, 3), tx)
+    step = build_train_step(
+        mesh, state, compute_dtype=jnp.float32, rules=RULES_TP,
+        logical_axes=axes,
+    )
+    rng = np.random.default_rng(1)
+    batch = shard_batch(
+        mesh,
+        {
+            "image": rng.standard_normal((8, 32, 32, 3)).astype(np.float32),
+            "label": rng.integers(0, 11, (8,)).astype(np.int32),
+        },
+    )
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_remat_matches_no_remat():
+    imgs = jnp.asarray(
+        np.random.default_rng(2).standard_normal((2, 32, 32, 3)), jnp.float32
+    )
+    base = get_model("vit-b16", **TINY)
+    params = base.init(jax.random.key(0), imgs, train=False)
+    want = base.apply(params, imgs, train=False)
+    got = get_model("vit-b16", **dict(TINY, remat="full")).apply(
+        params, imgs, train=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-6
+    )
