@@ -111,3 +111,22 @@ def test_remat_matches_no_remat():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=1e-6
     )
+
+
+def test_imagenet_workload_trains_vit():
+    """--model vit-b16 rides the ImageNet trainer unchanged (synthetic)."""
+    from distributeddeeplearning_tpu.workloads.imagenet import main
+
+    state, fit = main(
+        model="vit-b16",
+        epochs=1,
+        steps_per_epoch=2,
+        batch_size=2,
+        image_size=32,
+        num_classes=11,
+        compute_dtype="float32",
+        data_format="synthetic",
+        resume=False,
+        distributed=False,
+    )
+    assert np.isfinite(fit.final_train_metrics["loss"])
