@@ -82,9 +82,13 @@ def main(
     compute_dtype: str = "bfloat16",
     distributed: Optional[bool] = None,
     data_format: str = "synthetic",  # LM data is synthetic-only (see module doc)
-    # parallelism geometry: pipeline stages × sequence × data (remainder)
+    # parallelism geometry: pipeline × sequence × fsdp × data (remainder)
     pipe: int = 1,
     seq: int = 1,  # sequence-parallel axis (ring / ulysses attention)
+    # ZeRO-3-style parameter sharding: embed/head shard their vocab dim,
+    # qkv/proj/FF their width dims, over the fsdp axis (batch shards over
+    # it too).  Requires vocab_size, d_model and d_ff divisible by fsdp.
+    fsdp: int = 1,
     num_slices: int = 1,  # multi-slice (DCN) data parallelism
     num_microbatches: int = 8,
     # jax.checkpoint each pipeline tick (pipe>1, ops/pipeline.py) or each
@@ -158,8 +162,17 @@ def main(
             "loss_chunk uses the sequential forward and cannot combine "
             "with pipe > 1"
         )
+    if fsdp > 1 and (
+        vocab_size % fsdp or d_model % fsdp or d_ff % fsdp
+    ):
+        raise ValueError(
+            f"fsdp={fsdp} must divide vocab_size ({vocab_size}), "
+            f"d_model ({d_model}) and d_ff ({d_ff})"
+        )
     ctx = initialize(force=distributed)
-    mesh = create_mesh(MeshSpec(pipe=pipe, seq=seq), num_slices=num_slices)
+    mesh = create_mesh(
+        MeshSpec(pipe=pipe, seq=seq, fsdp=fsdp), num_slices=num_slices
+    )
     attention_fn = None
     if attention == "ring":
         from distributeddeeplearning_tpu.ops import make_ring_attention
@@ -248,17 +261,23 @@ def main(
         tx=tx,
     )
 
-    # One rule: the stacked layer dim shards over pipe (contiguous stages —
-    # exactly the [S, L/S] reshape forward_pipelined performs); everything
-    # else replicates.
-    rules = [("layers", "pipe")]
+    # The stacked layer dim shards over pipe (contiguous stages — exactly
+    # the [S, L/S] reshape forward_pipelined performs); the vocab and
+    # width dims shard over fsdp (no-ops at fsdp=1, so the pure-pipe and
+    # pure-DP geometries are unchanged).
+    rules = [("layers", "pipe"), ("vocab", "fsdp"), ("width", "fsdp")]
     logical_axes = {
-        "embed": None,
+        "embed": ("vocab", None),          # [V, D]
         "pos": None,
-        "head": None,
-        "blocks": jax.tree_util.tree_map(
-            lambda a: ("layers",) + (None,) * (a.ndim - 1), params["blocks"]
-        ),
+        "head": (None, "vocab"),           # [D, V]
+        "blocks": {
+            "qkv": ("layers", None, "width"),    # [L, D, 3D]
+            "proj": ("layers", "width", None),   # [L, D, D]
+            "w_in": ("layers", None, "width"),   # [L, D, FF]
+            "w_out": ("layers", "width", None),  # [L, FF, D]
+            "ln1": ("layers", None),
+            "ln2": ("layers", None),
+        },
     }
 
     if loss_chunk:

@@ -354,3 +354,61 @@ def test_causal_ring_lm_emits_collective_permutes():
         hlo, "collective-permute-start"
     )
     assert cp, "causal ring LM compiled without collective-permute"
+
+
+def test_fsdp_lm_emits_param_allgathers():
+    """--fsdp on the LM workload: sharded embed/head/FF params must be
+    all-gathered for compute (ZeRO-3 signature) rather than silently
+    replicated."""
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        forward,
+        init_params,
+        next_token_loss,
+    )
+    from distributeddeeplearning_tpu.train.state import TrainState
+
+    mesh = create_mesh(MeshSpec(fsdp=N_DEV), devices=jax.devices()[:N_DEV])
+    params = init_params(
+        jax.random.key(0), num_layers=2, d_model=32, num_heads=2, d_ff=64,
+        vocab_size=64, max_len=16,
+    )
+
+    def apply_fn(variables, tokens, train=True, mutable=None, rngs=None):
+        logits = forward(variables["params"], tokens, num_heads=2)
+        if mutable is not None:
+            return logits, {}
+        return logits
+
+    tx = optax.sgd(0.1)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        opt_state=tx.init(params), batch_stats={}, apply_fn=apply_fn, tx=tx,
+    )
+    rules = [("layers", "pipe"), ("vocab", "fsdp"), ("width", "fsdp")]
+    axes = {
+        "embed": ("vocab", None),
+        "pos": None,
+        "head": (None, "vocab"),
+        "blocks": {
+            "qkv": ("layers", None, "width"),
+            "proj": ("layers", "width", None),
+            "w_in": ("layers", None, "width"),
+            "w_out": ("layers", "width", None),
+            "ln1": ("layers", None),
+            "ln2": ("layers", None),
+        },
+    }
+    step = build_train_step(
+        mesh, state, compute_dtype=jnp.float32, rules=rules,
+        logical_axes=axes,
+        loss_fn=lambda lg, lb, label_smoothing=0.0: next_token_loss(lg, lb),
+        metrics_fn=lambda lg, lb, loss: {"loss": loss.astype(jnp.float32)},
+    )
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, (2 * N_DEV, 16)).astype(np.int32)
+    batch = shard_batch(mesh, {"input": toks, "label": toks})
+    hlo = compiled_hlo(step, state, batch)
+    ag = collective_ops(hlo, "all-gather") + collective_ops(
+        hlo, "all-gather-start"
+    )
+    assert ag, "fsdp LM compiled without any param all-gather"

@@ -131,3 +131,12 @@ def test_lm_all_levers_compose():
     )
     assert np.isfinite(fit.final_train_metrics["loss"])
     assert "perplexity" in fit.final_train_metrics
+
+
+def test_lm_fsdp_trains():
+    """--fsdp 2 shards embed/head (vocab dim) and qkv/FF widths; the run
+    must train and validate divisibility."""
+    state, fit = lm_main(fsdp=2, **TINY)
+    assert np.isfinite(fit.final_train_metrics["loss"])
+    with pytest.raises(ValueError, match="fsdp"):
+        lm_main(fsdp=2, **dict(TINY, vocab_size=65))
