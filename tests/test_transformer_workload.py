@@ -140,3 +140,9 @@ def test_lm_fsdp_trains():
     assert np.isfinite(fit.final_train_metrics["loss"])
     with pytest.raises(ValueError, match="fsdp"):
         lm_main(fsdp=2, **dict(TINY, vocab_size=65))
+
+
+def test_lm_loss_chunk_composes_with_accum():
+    """Microbatched gradient accumulation over the fused head+CE path."""
+    state, fit = lm_main(loss_chunk=5, accum_steps=2, **TINY)
+    assert np.isfinite(fit.final_train_metrics["loss"])
