@@ -130,3 +130,25 @@ def test_imagenet_workload_trains_vit():
         distributed=False,
     )
     assert np.isfinite(fit.final_train_metrics["loss"])
+
+
+def test_flash_attention_injects_into_vit():
+    """The injectable-attention contract: the Pallas kernel (interpret mode
+    on CPU) slots into ViT and matches the default dense path."""
+    from distributeddeeplearning_tpu.ops.flash_attention import (
+        make_flash_attention,
+    )
+
+    imgs = jnp.asarray(
+        np.random.default_rng(3).standard_normal((2, 32, 32, 3)), jnp.float32
+    )
+    base = get_model("vit-b16", **TINY)
+    params = base.init(jax.random.key(0), imgs, train=False)
+    want = base.apply(params, imgs, train=False)
+    flash_model = get_model(
+        "vit-b16", attention_fn=make_flash_attention(), **TINY
+    )
+    got = flash_model.apply(params, imgs, train=False)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
