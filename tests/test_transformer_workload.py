@@ -146,3 +146,12 @@ def test_lm_loss_chunk_composes_with_accum():
     """Microbatched gradient accumulation over the fused head+CE path."""
     state, fit = lm_main(loss_chunk=5, accum_steps=2, **TINY)
     assert np.isfinite(fit.final_train_metrics["loss"])
+
+
+def test_lm_ulysses_flash_all_levers():
+    """Ulysses×flash + remat + chunked head+CE in one training run — the
+    all-to-all flavor of the flagship long-context composition."""
+    state, fit = lm_main(
+        attention="ulysses-flash", seq=2, remat=True, loss_chunk=5, **TINY
+    )
+    assert np.isfinite(fit.final_train_metrics["loss"])
