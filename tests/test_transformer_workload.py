@@ -155,3 +155,11 @@ def test_lm_ulysses_flash_all_levers():
         attention="ulysses-flash", seq=2, remat=True, loss_chunk=5, **TINY
     )
     assert np.isfinite(fit.final_train_metrics["loss"])
+
+
+def test_lm_pipe_composes_with_fsdp():
+    """pipe=2 x fsdp=2 x data=2 on the 8-device pod: GPipe stages with
+    ZeRO-sharded embed/head/width params (XLA reshards at the pipeline
+    shard_map boundary)."""
+    state, fit = lm_main(pipe=2, fsdp=2, num_microbatches=2, **TINY)
+    assert np.isfinite(fit.final_train_metrics["loss"])
