@@ -163,3 +163,10 @@ def test_lm_pipe_composes_with_fsdp():
     shard_map boundary)."""
     state, fit = lm_main(pipe=2, fsdp=2, num_microbatches=2, **TINY)
     assert np.isfinite(fit.final_train_metrics["loss"])
+
+
+def test_lm_seq_composes_with_fsdp():
+    """seq=2 (causal ring) x fsdp=2 x data=2: sequence parallelism over
+    ZeRO-sharded params."""
+    state, fit = lm_main(attention="ring", seq=2, fsdp=2, **TINY)
+    assert np.isfinite(fit.final_train_metrics["loss"])
