@@ -89,6 +89,11 @@ def main(
     # qkv/proj/FF their width dims, over the fsdp axis (batch shards over
     # it too).  Requires vocab_size, d_model and d_ff divisible by fsdp.
     fsdp: int = 1,
+    # Megatron-style tensor parallelism: the SAME width dims shard over
+    # the tensor axis instead (batch does NOT shard over it, so XLA emits
+    # row-parallel activation all-reduces rather than param all-gathers).
+    # Composes with fsdp (vocab stays on fsdp) and pipe.
+    tensor: int = 1,
     num_slices: int = 1,  # multi-slice (DCN) data parallelism
     num_microbatches: int = 8,
     # jax.checkpoint each pipeline tick (pipe>1, ops/pipeline.py) or each
@@ -169,9 +174,15 @@ def main(
             f"fsdp={fsdp} must divide vocab_size ({vocab_size}), "
             f"d_model ({d_model}) and d_ff ({d_ff})"
         )
+    if tensor > 1 and (d_model % tensor or d_ff % tensor):
+        raise ValueError(
+            f"tensor={tensor} must divide d_model ({d_model}) and "
+            f"d_ff ({d_ff})"
+        )
     ctx = initialize(force=distributed)
     mesh = create_mesh(
-        MeshSpec(pipe=pipe, seq=seq, fsdp=fsdp), num_slices=num_slices
+        MeshSpec(pipe=pipe, seq=seq, fsdp=fsdp, tensor=tensor),
+        num_slices=num_slices,
     )
     attention_fn = None
     if attention == "ring":
@@ -262,10 +273,14 @@ def main(
     )
 
     # The stacked layer dim shards over pipe (contiguous stages — exactly
-    # the [S, L/S] reshape forward_pipelined performs); the vocab and
-    # width dims shard over fsdp (no-ops at fsdp=1, so the pure-pipe and
-    # pure-DP geometries are unchanged).
-    rules = [("layers", "pipe"), ("vocab", "fsdp"), ("width", "fsdp")]
+    # the [S, L/S] reshape forward_pipelined performs); the vocab dim
+    # shards over fsdp; the width dims shard over tensor when --tensor > 1
+    # (Megatron TP: batch not sharded over it → row-parallel activation
+    # all-reduces) and over fsdp otherwise (ZeRO: batch sharded over it →
+    # param all-gathers).  Everything is a no-op at axis size 1, so the
+    # pure-pipe and pure-DP geometries are unchanged.
+    width_axis = "tensor" if tensor > 1 else "fsdp"
+    rules = [("layers", "pipe"), ("vocab", "fsdp"), ("width", width_axis)]
     logical_axes = {
         "embed": ("vocab", None),          # [V, D]
         "pos": None,
