@@ -144,6 +144,15 @@ def _build_lm_bench(args, devices=None):
         dims = dict(num_layers=2, d_model=64, num_heads=4, d_ff=128,
                     vocab_size=257)
     attention = "flash" if args.attention == "flash" else "dense"
+    attention_fn = None
+    if attention == "flash" and n_dev > 1:
+        # Same GSPMD rule as workloads/transformer.py: a bare pallas_call
+        # can't be partitioned, so on a multi-chip mesh the kernel must run
+        # per-shard inside shard_map or every chip gathers the global batch
+        # (and the sweep would measure the gather, not the step).
+        from distributeddeeplearning_tpu.ops import make_flash_attention
+
+        attention_fn = make_flash_attention(mesh=mesh, causal=True)
 
     params = init_params(
         jax.random.key(0), max_len=args.seq_len, **dims
@@ -161,11 +170,13 @@ def _build_lm_bench(args, devices=None):
             # memory lever; see models.pipelined_transformer.per_token_loss).
             out = per_token_loss(
                 p, tokens, num_heads=dims["num_heads"], attention=attention,
+                attention_fn=attention_fn,
                 remat=args.remat != "none", loss_chunk=args.loss_chunk,
             )
         else:
             out = forward(
                 p, tokens, num_heads=dims["num_heads"], attention=attention,
+                attention_fn=attention_fn,
                 remat=args.remat != "none",
             ).astype(jnp.float32)
         if mutable is not None:
@@ -271,11 +282,14 @@ def _run_single(args) -> int:
     if args.model == "lm":
         # XLA's cost model assigns ZERO FLOPs to pallas custom-calls, so the
         # compiled count understates the flash path (and even the dense LM
-        # reads low through the scan).  Use the standard analytic estimate:
-        # 6·N·T for the parameter matmuls (fwd + bwd), plus the attention
-        # score/context matmuls 3·(2 or 4)·B·S²·d·L — halved for the causal
-        # flash kernel because its masked k-tiles genuinely skip compute,
-        # full for dense which multiplies the masked entries anyway.
+        # reads low through the scan).  Use the standard analytic MODEL-FLOPs
+        # estimate — 6·N·T parameter matmuls (fwd + bwd) plus the CAUSAL
+        # attention score/context matmuls 3·2·B·S²·d·L — for BOTH attention
+        # modes.  Causal model FLOPs are what the model requires; dense
+        # attention also multiplies the masked half, and under this one
+        # convention that waste correctly shows up as LOWER MFU rather than
+        # inflating it (the r4 advisor flagged the old per-mode convention
+        # as incomparable across rows).
         import numpy as _np
 
         n_params = sum(
@@ -283,17 +297,15 @@ def _run_single(args) -> int:
             for a in jax.tree_util.tree_leaves(state.params)
         )
         lm_layers, lm_d = (2, 64) if args.small else (12, 768)
-        attn_fwd_per_layer = (
-            (2 if args.attention == "flash" else 4)
-            * global_batch * args.seq_len ** 2 * lm_d
-        )
+        attn_fwd_per_layer = 2 * global_batch * args.seq_len ** 2 * lm_d
         flops = (
             6 * n_params * global_batch * args.seq_len
             + 3 * attn_fwd_per_layer * lm_layers
         )
         flops_source = (
-            "analytic 6NT + 3x attention matmuls (causal-halved for flash); "
-            "XLA cost model counts pallas custom-calls as 0 FLOPs"
+            "analytic causal model flops: 6NT + 3x causal attention matmuls "
+            "(2BS^2dL fwd), same convention for dense and flash; XLA cost "
+            "model counts pallas custom-calls as 0 FLOPs"
         )
 
     trace = (

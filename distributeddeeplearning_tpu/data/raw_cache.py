@@ -58,10 +58,27 @@ LABELS = "labels.i32"
 _VERSION = 1
 
 
-def cache_path_for(data_dir: str, is_training: bool, image_size: int) -> str:
-    """Default cache location next to the shard set."""
+def cache_path_for(
+    data_dir: str,
+    is_training: bool,
+    image_size: int,
+    *,
+    shard_count: int = 1,
+    shard_index: int = 0,
+) -> str:
+    """Default cache location next to the shard set.
+
+    With ``shard_count > 1`` (multi-host: each host caches only its
+    shard-file slice) the directory name carries the host's slice — on
+    shared storage (NFS / GCS-fuse) all hosts would otherwise build
+    DIFFERENT slices into the SAME images.u8/manifest path and clobber
+    each other.
+    """
     split = "train" if is_training else "validation"
-    return os.path.join(data_dir, f"raw-cache-{split}-{image_size}")
+    suffix = (
+        f"-shard{shard_index}of{shard_count}" if shard_count > 1 else ""
+    )
+    return os.path.join(data_dir, f"raw-cache-{split}-{image_size}{suffix}")
 
 
 def _load_manifest(cache_dir: str) -> Optional[dict]:

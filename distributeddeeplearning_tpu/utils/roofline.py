@@ -52,7 +52,12 @@ def _arg(args: Dict[str, Any], keys) -> Optional[float]:
 
 def device_op_events(trace_file: str) -> List[Dict[str, Any]]:
     """Complete ("X") events that look like device HLO ops: have a duration
-    and an XLA cost-model byte count in their args."""
+    and an XLA cost-model byte count in their args.
+
+    Each event carries its trace ``pid`` (the device/lane it ran on) so
+    multi-chip traces can be disaggregated per device — summing across
+    lanes would inflate device time by ~n_devices.
+    """
     opener = gzip.open if trace_file.endswith(".gz") else open
     with opener(trace_file, "rt") as f:
         trace = json.load(f)
@@ -76,6 +81,7 @@ def device_op_events(trace_file: str) -> List[Dict[str, Any]]:
                 "bytes": nbytes,
                 "flops": _arg(args, _FLOPS_KEYS) or 0.0,
                 "category": category or "uncategorized",
+                "pid": ev.get("pid", 0),
             }
         )
     return out
@@ -102,6 +108,17 @@ def analyze_trace(
     events = device_op_events(find_trace_file(trace_dir))
     if not events:
         raise ValueError(f"no device HLO events with byte counts in {trace_dir}")
+
+    # A multi-chip trace has one lane (pid) per device; the per-device
+    # roofline comes from ONE lane — summing all lanes would multiply
+    # device time and bytes by ~n_devices.  Analyze the busiest lane (on a
+    # single-chip trace that is simply the only lane).
+    lane_us: Dict[Any, float] = {}
+    for e in events:
+        lane_us[e["pid"]] = lane_us.get(e["pid"], 0.0) + e["dur_us"]
+    n_lanes = len(lane_us)
+    busiest = max(lane_us, key=lane_us.get)
+    events = [e for e in events if e["pid"] == busiest]
 
     total_us = sum(e["dur_us"] for e in events)
     total_bytes = sum(e["bytes"] for e in events)
@@ -160,6 +177,7 @@ def analyze_trace(
     measured_ms = total_us / steps / 1e3
     result: Dict[str, Any] = {
         "steps_analyzed": steps,
+        "device_lanes_in_trace": n_lanes,
         "device_ms_per_step": round(measured_ms, 2),
         "hbm_gb_per_step": round(bytes_per_step / 1e9, 2),
         "model_gflops_per_step": round(total_flops / steps / 1e9, 1),

@@ -101,7 +101,14 @@ def _batches(
                 raw_cache_input_fn,
             )
 
-            cache_dir = cache_path_for(data_path, is_training, image_size)
+            # Per-host cache dir: cache_path_for suffixes the slice when
+            # process_count > 1 so hosts on shared storage don't clobber
+            # each other's images.u8/manifest.
+            cache_dir = cache_path_for(
+                data_path, is_training, image_size,
+                shard_count=jax.process_count(),
+                shard_index=jax.process_index(),
+            )
             if jax.process_count() > 1:
                 # Each host caches only its own shard-file slice.
                 build_raw_cache(

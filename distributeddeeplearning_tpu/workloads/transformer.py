@@ -174,10 +174,12 @@ def main(
             f"fsdp={fsdp} must divide vocab_size ({vocab_size}), "
             f"d_model ({d_model}) and d_ff ({d_ff})"
         )
-    if tensor > 1 and (d_model % tensor or d_ff % tensor):
+    if tensor > 1 and (
+        d_model % tensor or d_ff % tensor or num_heads % tensor
+    ):
         raise ValueError(
-            f"tensor={tensor} must divide d_model ({d_model}) and "
-            f"d_ff ({d_ff})"
+            f"tensor={tensor} must divide d_model ({d_model}), "
+            f"d_ff ({d_ff}) and num_heads ({num_heads})"
         )
     ctx = initialize(force=distributed)
     mesh = create_mesh(
@@ -197,6 +199,16 @@ def main(
         attention_fn = make_ulysses_attention(
             mesh, causal=True, use_flash=attention == "ulysses-flash"
         )
+    elif attention == "flash" and pipe == 1 and mesh.devices.size > 1:
+        # A bare pallas_call cannot be partitioned by GSPMD — on a
+        # multi-chip mesh the kernel must run per-shard inside shard_map
+        # (batch over data/fsdp, heads over tensor) or every chip gathers
+        # the global batch.  Inside a pipeline stage (pipe > 1) the
+        # pipeline's own shard_map already scopes it, so only the
+        # sequential forward needs the wrap.
+        from distributeddeeplearning_tpu.ops import make_flash_attention
+
+        attention_fn = make_flash_attention(mesh=mesh, causal=True)
     data_shards = mesh.shape["data"] * mesh.shape["fsdp"]
     global_batch = batch_size * data_shards
     per_host_batch = global_batch // ctx.process_count
