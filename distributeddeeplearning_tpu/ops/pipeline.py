@@ -53,6 +53,7 @@ def pipeline_apply(
     num_microbatches: int,
     axis_name: str = "pipe",
     remat: bool = False,
+    param_partition: PyTree = None,
 ) -> jax.Array:
     """Run ``stage_fn`` S times as a pipeline: ``y = fS(...f2(f1(x)))``.
 
@@ -69,6 +70,16 @@ def pipeline_apply(
     memory role 1F1B scheduling plays in hand-scheduled pipelines, obtained
     compiler-natively.  Activation memory drops from
     O(ticks × stage_internals) to O(ticks × microbatch_boundary).
+
+    ``param_partition`` composes the pipe axis with intra-stage model
+    parallelism: a pytree matching ``stage_params`` whose leaves are
+    per-dim axis names (tuple, WITHOUT the leading stage dim — e.g.
+    ``("tensor", None)`` shards a ``[S, d_ff, d]`` leaf's d_ff over the
+    ``tensor`` axis) or None for replicated.  ``stage_fn`` then sees its
+    LOCAL shard of each weight and is responsible for the matching
+    collectives (``psum`` over ``tensor`` for Megatron partial sums,
+    ``all_gather`` over ``fsdp`` for ZeRO-3 gathers) — the same contract
+    shard_map gives every op in this package.
     """
     n_stages = int(mesh.shape[axis_name])
     leaves = jax.tree_util.tree_leaves(stage_params)
@@ -97,9 +108,30 @@ def pipeline_apply(
         )
 
     m = num_microbatches
-    param_spec = jax.tree_util.tree_map(
-        lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), stage_params
-    )
+    if param_partition is None:
+        param_spec = jax.tree_util.tree_map(
+            lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))),
+            stage_params,
+        )
+    else:
+        def _leaf_spec(leaf, part):
+            dims = tuple(part) if part is not None else ()
+            if len(dims) > leaf.ndim - 1:
+                raise ValueError(
+                    f"param_partition {part} has more dims than leaf "
+                    f"shape {leaf.shape} minus the stage dim"
+                )
+            dims = dims + (None,) * (leaf.ndim - 1 - len(dims))
+            return P(axis_name, *dims)
+
+        p_leaves, treedef = jax.tree_util.tree_flatten(stage_params)
+        # flatten_up_to (not tree_map): partition leaves may be None, which
+        # tree_map would treat as an empty subtree and reject.
+        part_leaves = treedef.flatten_up_to(param_partition)
+        param_spec = jax.tree_util.tree_unflatten(
+            treedef,
+            [_leaf_spec(a, p) for a, p in zip(p_leaves, part_leaves)],
+        )
     x_spec = P(DATA_AXES, *([None] * (x.ndim - 1)))
 
     tick_stage_fn = jax.checkpoint(stage_fn) if remat else stage_fn
