@@ -26,6 +26,49 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# --- two-tier suite -------------------------------------------------------
+# tests/slow_tests.txt lists test IDs (relative to tests/, parametrized IDs
+# cover every param) measured over ~5 s on a single core; conftest marks
+# them ``slow`` at collection so ``make test-fast`` (-m "not slow") stays
+# under its CI budget.  Regenerate after perf-relevant changes with:
+#   python -m pytest tests/ -q --durations=80   (then paste calls >5 s)
+_SLOW_MANIFEST = os.path.join(os.path.dirname(__file__), "slow_tests.txt")
+
+
+def _slow_ids():
+    try:
+        with open(_SLOW_MANIFEST) as f:
+            return {ln.strip() for ln in f if ln.strip() and not ln.startswith("#")}
+    except OSError:
+        return None
+
+
+def pytest_collection_modifyitems(config, items):
+    slow = _slow_ids()
+    if slow is None:
+        # Without the manifest the "fast" tier silently becomes the full
+        # ~45-minute suite; make the degradation loud.
+        import warnings
+
+        warnings.warn(
+            f"slow-test manifest {_SLOW_MANIFEST} missing — no slow marks "
+            "applied, -m 'not slow' will run (almost) everything",
+            stacklevel=1,
+        )
+        return
+    if not slow:
+        return
+    for item in items:
+        # item.nodeid is "tests/test_x.py::test_y[param]"; the manifest
+        # stores it without the tests/ prefix and without param brackets so
+        # one line covers every parametrization.
+        nodeid = item.nodeid
+        if nodeid.startswith("tests/"):
+            nodeid = nodeid[len("tests/"):]
+        base = nodeid.split("[", 1)[0]
+        if nodeid in slow or base in slow:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture
 def tmp_env(tmp_path):
