@@ -172,12 +172,13 @@ def _build_lm_bench(args, devices=None):
                 p, tokens, num_heads=dims["num_heads"], attention=attention,
                 attention_fn=attention_fn,
                 remat=args.remat != "none", loss_chunk=args.loss_chunk,
+                unroll=args.scan_unroll,
             )
         else:
             out = forward(
                 p, tokens, num_heads=dims["num_heads"], attention=attention,
                 attention_fn=attention_fn,
-                remat=args.remat != "none",
+                remat=args.remat != "none", unroll=args.scan_unroll,
             ).astype(jnp.float32)
         if mutable is not None:
             return out, {}
@@ -753,6 +754,11 @@ def main() -> int:
     parser.add_argument("--num-warmup", type=int, default=10)
     parser.add_argument(
         "--small", action="store_true", help="tiny shapes for CI smoke"
+    )
+    parser.add_argument(
+        "--scan-unroll", type=int, default=1,
+        help="LM layer-scan unroll factor (removes scan-carry DUS traffic "
+        "from the backward at the cost of compile time)",
     )
     parser.add_argument(
         "--fp32", action="store_true", help="disable bf16 compute"

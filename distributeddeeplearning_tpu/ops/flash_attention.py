@@ -43,6 +43,14 @@ except ImportError:  # pragma: no cover
 
 NEG_BIG = -1e30  # finite mask fill; -inf poisons the online-softmax max
 
+# The online softmax runs in base 2: exp(x) = exp2(x·log2e) folded into the
+# score scale, because exp2 is the TPU transcendental primitive (exp costs
+# an extra multiply per element, and the [bq, bk] exponentials are the
+# kernel's dominant VPU work).  The saved logsumexp stays in NATS at the
+# interface — callers (ulysses composition, tests) never see base 2.
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
+
 
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -57,7 +65,7 @@ def _causal_tile_bias(row0, col0, bq, bk):
 
 
 def _kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-            *, scale: float, causal: bool):
+            *, scale: float, causal: bool, has_bias: bool):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -72,28 +80,35 @@ def _kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref
         k = k_ref[0]  # bf16×bf16→f32 path; casting to f32 first would quarter
         v = v_ref[0]  # the matmul rate
         bq, bk = q.shape[0], k.shape[0]
+        # base-2 domain: scores pre-multiplied by log2e, exponentials via
+        # exp2 (see LOG2E above)
         s = (
             jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            * scale
-        )  # [bq, bk] f32
-        s = s + bias_ref[0, 0][None, :]  # additive key-padding bias
+            * (scale * LOG2E)
+        )  # [bq, bk] f32, base-2 scaled
+        if has_bias:
+            # key-padding bias is 0 or NEG_BIG — no rescaling needed, and
+            # mask-free callers (the causal LM path) skip the add entirely
+            s = s + bias_ref[0, 0][None, :]
         if causal:
             s = s + _causal_tile_bias(qi * bq, ki * bk, bq, bk)
 
         m_prev = m_ref[:, :1]  # [bq, 1]
         m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_cur)
-        correction = jnp.exp(m_prev - m_cur)
+        p = jnp.exp2(s - m_cur)
+        correction = jnp.exp2(m_prev - m_cur)
         l_new = l_ref[:, :1] * correction + p.sum(axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        # narrow [bq, 1] stores: only lane 0 is ever read back, and the
+        # full-width broadcast was 1 MB of redundant VMEM writes per tile
+        m_ref[:, :1] = m_cur
+        l_ref[:, :1] = l_new
 
     if causal:
         # Whole-tile skip past the diagonal: k block ki contributes to q
@@ -111,11 +126,13 @@ def _kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref
     def _finalize():
         l = jnp.maximum(l_ref[:, :1], 1e-30)  # fully-masked rows stay finite
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(l[:, 0])
+        # convert the base-2 running max back to a NAT-unit logsumexp
+        lse_ref[0, 0] = (m_ref[:, 0] + jnp.log2(l[:, 0])) * LN2
 
 
 def _flash_fwd_pallas(q3, k3, v3, bias2, *, heads: int, block_q: int,
-                      block_k: int, out_dtype, causal: bool = False):
+                      block_k: int, out_dtype, causal: bool = False,
+                      has_bias: bool = True):
     """q3/k3/v3: [BH, S, D]; bias2: [B, S] f32 → (o [BH,S,D], lse [BH,S])."""
     if pltpu is None:  # pragma: no cover
         raise RuntimeError("pallas TPU support unavailable in this jax build")
@@ -123,7 +140,8 @@ def _flash_fwd_pallas(q3, k3, v3, bias2, *, heads: int, block_q: int,
     scale = 1.0 / (d ** 0.5)
     grid = (bh, s // block_q, s // block_k)
 
-    kernel = functools.partial(_kernel, scale=scale, causal=causal)
+    kernel = functools.partial(_kernel, scale=scale, causal=causal,
+                               has_bias=has_bias)
     compiler_params = None
     if not _use_interpret():
         compiler_params = pltpu.CompilerParams(
@@ -166,7 +184,8 @@ def _flash_fwd_pallas(q3, k3, v3, bias2, *, heads: int, block_q: int,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, acc_ref, *, scale: float, causal: bool):
+                   dq_ref, acc_ref, *, scale: float, causal: bool,
+                   has_bias: bool):
     """dq pass: one q block resident, stream k/v blocks (grid dim 2)."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -180,7 +199,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0, 0]      # [bq]
+        lse = lse_ref[0, 0]      # [bq], nats
         delta = delta_ref[0, 0]  # [bq] = rowsum(dO ⊙ O)
         bq, bk = q.shape[0], k.shape[0]
         s = (
@@ -188,12 +207,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            * scale
-            + bias_ref[0, 0][None, :]
+            * (scale * LOG2E)
         )
+        if has_bias:
+            s = s + bias_ref[0, 0][None, :]
         if causal:
             s = s + _causal_tile_bias(qi * bq, ki * bk, bq, bk)
-        p = jnp.exp(s - lse[:, None])  # exact probs from the saved logsumexp
+        # exact probs from the saved logsumexp, in the base-2 domain:
+        # exp(s_nat - lse) == exp2(s_base2 - lse·log2e)
+        p = jnp.exp2(s - (lse * LOG2E)[:, None])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -217,7 +239,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
-                    causal: bool):
+                    causal: bool, has_bias: bool):
     """dk/dv pass: one k block resident, stream q blocks (grid dim 2).
     Works transposed ([bk, bq] tiles) so the accumulators index by key."""
     ci = pl.program_id(1)  # k-block index (resident)
@@ -233,7 +255,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0, 0]      # [bq]
+        lse = lse_ref[0, 0]      # [bq], nats
         delta = delta_ref[0, 0]  # [bq]
         bq, bk = q.shape[0], k.shape[0]
         st = (
@@ -241,9 +263,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
                 k, q, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            * scale
-            + bias_ref[0, 0][:, None]
-        )  # [bk, bq]
+            * (scale * LOG2E)
+        )  # [bk, bq], base-2 scaled
+        if has_bias:
+            st = st + bias_ref[0, 0][:, None]
         if causal:
             # transposed tile: rows are keys (global ci*bk+r), cols are
             # queries (global qi*bq+c); key visible when key_pos <= query_pos
@@ -252,7 +275,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
             st = st + jnp.where(keys <= queries, 0.0, NEG_BIG).astype(
                 jnp.float32
             )
-        pt = jnp.exp(st - lse[None, :])
+        pt = jnp.exp2(st - (lse * LOG2E)[None, :])
         dv_acc[:] += jax.lax.dot_general(
             pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -282,7 +305,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q3, k3, v3, bias2, o3, lse, do3, *, heads: int,
-                      block_q: int, block_k: int, causal: bool = False):
+                      block_q: int, block_k: int, causal: bool = False,
+                      has_bias: bool = True):
     """FlashAttention-2 backward: (dq, dk, dv), each [BH, S, D]."""
     if pltpu is None:  # pragma: no cover
         raise RuntimeError("pallas TPU support unavailable in this jax build")
@@ -308,7 +332,8 @@ def _flash_bwd_pallas(q3, k3, v3, bias2, o3, lse, do3, *, heads: int,
     )
     row_spec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
     dq3 = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal),
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          has_bias=has_bias),
         grid=(bh, s // block_q, s // block_k),
         in_specs=[q_spec, k_spec, k_spec, bias_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
@@ -327,7 +352,8 @@ def _flash_bwd_pallas(q3, k3, v3, bias2, o3, lse, do3, *, heads: int,
     )
     row_spec2 = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, j))
     dk3, dv3 = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal),
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          has_bias=has_bias),
         grid=(bh, s // block_k, s // block_q),
         in_specs=[
             q_spec2, k_spec2, k_spec2, bias_spec2, q_spec2, row_spec2, row_spec2
@@ -348,12 +374,13 @@ def _flash_bwd_pallas(q3, k3, v3, bias2, o3, lse, do3, *, heads: int,
 
 
 def _make_core(heads: int, block_q: int, block_k: int, out_dtype,
-               causal: bool = False):
+               causal: bool = False, has_bias: bool = True):
     @jax.custom_vjp
     def core(q3, k3, v3, bias2):
         o, _ = _flash_fwd_pallas(
             q3, k3, v3, bias2, heads=heads, block_q=block_q,
             block_k=block_k, out_dtype=out_dtype, causal=causal,
+            has_bias=has_bias,
         )
         return o
 
@@ -361,6 +388,7 @@ def _make_core(heads: int, block_q: int, block_k: int, out_dtype,
         o, lse = _flash_fwd_pallas(
             q3, k3, v3, bias2, heads=heads, block_q=block_q,
             block_k=block_k, out_dtype=out_dtype, causal=causal,
+            has_bias=has_bias,
         )
         return o, (q3, k3, v3, bias2, o, lse)
 
@@ -369,11 +397,26 @@ def _make_core(heads: int, block_q: int, block_k: int, out_dtype,
         dq, dk, dv = _flash_bwd_pallas(
             q3, k3, v3, bias2, o, lse, do.astype(q3.dtype),
             heads=heads, block_q=block_q, block_k=block_k, causal=causal,
+            has_bias=has_bias,
         )
         return dq, dk, dv, jnp.zeros_like(bias2)
 
     core.defvjp(fwd, bwd)
     return core
+
+
+def _auto_block(s: int, cap: int = 1024) -> int:
+    """Largest power-of-two-descending divisor of ``s`` up to ``cap``.
+
+    1024 measured 15-25% faster than 512 on a v5e at seq 2048-32k (the
+    [bq, bk] f32 score tile is 4 MB of the 16 MB scoped VMEM; 2048-wide
+    tiles exceed the limit and fail to compile), so auto-selection starts
+    there and halves until it divides S — seq 1536 gets 512, not an error.
+    """
+    b = min(cap, s)
+    while s % b:
+        b //= 2
+    return b
 
 
 def flash_attention(
@@ -383,15 +426,16 @@ def flash_attention(
     mask: Optional[jax.Array],
     *,
     dtype: jnp.dtype,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     causal: bool = False,
 ) -> jax.Array:
     """Drop-in for ``models.bert.dot_product_attention``: [B, S, H, D] in/out.
 
     ``mask``: bool, broadcastable to [B, 1, 1, S] (key padding).  Blocks
-    clamp to the sequence length; S must be divisible by the (clamped)
-    block sizes.
+    default to auto-selection (:func:`_auto_block`: 1024 or the largest
+    halving that divides S); explicit blocks clamp to the sequence length
+    and S must be divisible by them.
 
     ``causal=True`` applies the autoregressive triangle (key_pos <=
     query_pos) INSIDE the kernel — fully-masked k-tiles skip their matmuls
@@ -400,8 +444,8 @@ def flash_attention(
     Composes with the key-padding ``mask``.
     """
     b, s, h, d = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
+    block_q = _auto_block(s) if block_q is None else min(block_q, s)
+    block_k = _auto_block(s) if block_k is None else min(block_k, s)
     if s % block_q or s % block_k:
         raise ValueError(
             f"seq len {s} not divisible by blocks ({block_q}, {block_k})"
@@ -413,12 +457,14 @@ def flash_attention(
         bias2 = jnp.where(key_mask, 0.0, NEG_BIG).astype(jnp.float32)
 
     to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)  # noqa: E731
-    core = _make_core(h, block_q, block_k, dtype, causal)
+    core = _make_core(h, block_q, block_k, dtype, causal,
+                      has_bias=mask is not None)
     o3 = core(to3(q), to3(k), to3(v), bias2)
     return o3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-def make_flash_attention(block_q: int = 512, block_k: int = 512, mesh=None,
+def make_flash_attention(block_q: Optional[int] = None,
+                         block_k: Optional[int] = None, mesh=None,
                          causal: bool = False):
     """Bind block sizes → an ``attention_fn`` for the transformer models.
 

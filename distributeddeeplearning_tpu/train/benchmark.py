@@ -98,22 +98,26 @@ def _windowed_benchmark(
         )
     img_secs: List[float] = []
     iter_times: List[float] = []
-    # The warmup above ended with a fetch, so t0 sits one D2H latency after
-    # a device-complete instant, same as every later timestamp.
-    t_prev = time.perf_counter()
+    # num_iters + 1 windows are dispatched; the FIRST is an unmeasured
+    # priming window — the warmup's blocking fetch drained the device, so
+    # window 0 uniquely pays the pipeline-refill RTT before the device
+    # resumes.  Timestamps start at window 0's fetch-completion; every
+    # delta after that is pure device throughput.
+    t_prev = None
     pending = None  # window i-1's metrics, fetched after window i dispatches
-    for _ in range(num_iters):
+    for _ in range(num_iters + 1):
         for _ in range(num_batches_per_iter):
             state, metrics = step_fn(state, next_batch())
         if pending is not None:
             float(pending["loss"])
             now = time.perf_counter()
-            dt = now - t_prev
+            if t_prev is not None:
+                dt = now - t_prev
+                iter_times.append(dt)
+                img_secs.append(
+                    global_batch * num_batches_per_iter / dt / num_devices
+                )
             t_prev = now
-            iter_times.append(dt)
-            img_secs.append(
-                global_batch * num_batches_per_iter / dt / num_devices
-            )
         pending = metrics
     float(pending["loss"])  # last window drains with nothing queued behind
     dt = time.perf_counter() - t_prev
@@ -205,8 +209,9 @@ def run_data_benchmark(
     gap in ``BENCH_DATA_*.json`` is produced.
 
     Raises ``StopIteration`` if the pipeline runs dry before
-    ``num_warmup_batches + num_iters*num_batches_per_iter`` batches; size the
-    dataset (or use a repeating pipeline) accordingly.
+    ``num_warmup_batches + (num_iters+1)*num_batches_per_iter`` batches
+    (one extra unmeasured priming window); size the dataset (or use a
+    repeating pipeline) accordingly.
     """
     if num_devices is None:
         num_devices = world_size()

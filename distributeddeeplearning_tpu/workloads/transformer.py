@@ -103,6 +103,11 @@ def main(
     # logits never materialize (models.per_token_loss; must divide
     # seq_len-1).  top1 is unavailable in this mode (no logits exist).
     loss_chunk: Optional[int] = None,
+    # lax.scan unroll factor for the layer stack: removes scan-carry
+    # dynamic-update-slice traffic from the backward (LM_FLASH_r05: best at
+    # short seq; keep 1 at long context -- the unrolled scan holds more
+    # live buffers and seq-64k OOMs at 12)
+    scan_unroll: int = 1,
     # "flash" = causal Pallas kernel (long context, single shard);
     # "ring"/"ulysses" = causal sequence-parallel attention over --seq
     attention: str = "dense",
@@ -166,6 +171,11 @@ def main(
         raise ValueError(
             "loss_chunk uses the sequential forward and cannot combine "
             "with pipe > 1"
+        )
+    if scan_unroll > 1 and pipe > 1:
+        raise ValueError(
+            "scan_unroll applies to the sequential scan-over-layers only "
+            "and has no effect inside pipeline stages; drop it or pipe"
         )
     if fsdp > 1 and (
         vocab_size % fsdp or d_model % fsdp or d_ff % fsdp
@@ -253,7 +263,7 @@ def main(
             out = per_token_loss(
                 p, tokens, num_heads=num_heads, attention=attention,
                 attention_fn=attention_fn, remat=remat,
-                loss_chunk=loss_chunk,
+                loss_chunk=loss_chunk, unroll=scan_unroll,
             )
         elif pipe > 1:
             out = forward_pipelined(
@@ -264,7 +274,8 @@ def main(
         else:
             out = forward(p, tokens, num_heads=num_heads,
                           attention=attention, attention_fn=attention_fn,
-                          remat=remat).astype(jnp.float32)
+                          remat=remat,
+                          unroll=scan_unroll).astype(jnp.float32)
         if mutable is not None:
             return out, {}
         return out

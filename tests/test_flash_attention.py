@@ -318,3 +318,28 @@ def test_pipelined_transformer_flash_matches_dense():
     np.testing.assert_allclose(
         np.asarray(flat_f), np.asarray(flat_d), atol=5e-4, rtol=5e-4
     )
+
+
+def test_auto_block_nondivisible_seq():
+    """Seq lens divisible by 512 but not 1024 (e.g. 1536) must auto-select
+    a smaller block instead of raising — regression for the 1024 default."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearning_tpu.ops.flash_attention import (
+        _auto_block,
+        flash_attention,
+    )
+
+    assert _auto_block(1536) == 512
+    assert _auto_block(2048) == 1024
+    assert _auto_block(2560) == 512
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 1536, 1, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    out = flash_attention(q, k, v, None, dtype=jnp.float32, causal=True)
+    assert out.shape == (1, 1536, 1, 8)
+    assert bool(jnp.isfinite(out).all())
