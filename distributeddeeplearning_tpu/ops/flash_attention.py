@@ -493,13 +493,19 @@ def make_flash_attention(block_q: Optional[int] = None,
 
         from distributeddeeplearning_tpu.parallel.compat import shard_map
 
-        if mask is None:
-            mask = jnp.ones((q.shape[0], 1, 1, q.shape[1]), bool)
-        else:
-            mask = jnp.broadcast_to(
-                mask, (q.shape[0], 1, 1, q.shape[1])
-            )
         qkv_spec = P(DATA_AXES, None, "tensor", None)
+        if mask is None:
+            # keep mask=None through the shard_map so the kernels compile
+            # with has_bias=False — fabricating an all-ones mask here would
+            # silently re-introduce the per-tile bias loads/adds the
+            # unmasked (causal-LM) path skips
+            return shard_map(
+                lambda q, k, v: _local(q, k, v, None, dtype),
+                mesh=mesh,
+                in_specs=(qkv_spec, qkv_spec, qkv_spec),
+                out_specs=qkv_spec,
+            )(q, k, v)
+        mask = jnp.broadcast_to(mask, (q.shape[0], 1, 1, q.shape[1]))
         mask_spec = P(DATA_AXES, None, None, None)
         return shard_map(
             lambda q, k, v, m: _local(q, k, v, m, dtype),
