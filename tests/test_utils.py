@@ -105,3 +105,41 @@ def test_shipped_logging_confs_load_via_log_config(monkeypatch, tmp_path):
         logger = setup_logging()
         assert logger.name == "ddlt"
         assert logging.getLogger("ddlt").isEnabledFor(logging.INFO)
+
+
+def test_windowed_benchmark_priming_and_window_count():
+    """The overlapped-window core dispatches num_iters+1 windows, measures
+    exactly num_iters deltas, and never fetches the priming window into the
+    stats (train/benchmark.py)."""
+    from distributeddeeplearning_tpu.train.benchmark import (
+        _windowed_benchmark,
+    )
+
+    calls = {"steps": 0, "batches": 0}
+
+    def step_fn(state, batch):
+        calls["steps"] += 1
+        return state, {"loss": 0.0}
+
+    def next_batch():
+        calls["batches"] += 1
+        return None
+
+    result = _windowed_benchmark(
+        step_fn,
+        state=None,
+        next_batch=next_batch,
+        model_name="fake",
+        batch_size_per_chip=4,
+        num_devices=2,
+        num_warmup_batches=3,
+        num_iters=5,
+        num_batches_per_iter=2,
+        log=None,
+        label="",
+    )
+    # 3 warmup + (5+1 windows) x 2 batches
+    assert calls["steps"] == 3 + 6 * 2 == calls["batches"]
+    assert len(result.iter_times_s) == 5  # priming window unmeasured
+    assert result.num_devices == 2
+    assert result.img_sec_total > 0
