@@ -213,6 +213,11 @@ def forward(
     return x @ params["head"]
 
 
+# Which width dim of each stacked block leaf ZeRO-3 shards (leaf layout
+# AFTER the stage dim is [L/S, ...]; ln scales stay replicated).
+_ZERO3_WIDTH_DIM = {"qkv": 2, "proj": 1, "w_in": 2, "w_out": 1}
+
+
 def forward_pipelined(
     params,
     tokens,
@@ -222,12 +227,28 @@ def forward_pipelined(
     num_microbatches: int,
     remat: bool = False,
     attention: str = "dense",
+    zero3_axis: Optional[str] = None,
 ) -> jax.Array:
     """Same function, stages sharded over the mesh's ``pipe`` axis.
 
     ``attention="flash"`` runs the causal Pallas kernel inside each stage —
     the kernel executes per-shard inside pipeline_apply's shard_map, so no
     extra mesh plumbing is needed.
+
+    ``zero3_axis`` (e.g. ``"fsdp"``) composes the pipe axis with ZeRO-3
+    weight sharding INSIDE each stage: every chip stores only a
+    1/axis-size width-slice of its stage's qkv/proj/FF weights
+    (``pipeline_apply``'s ``param_partition``) and all-gathers them per
+    tick; the gather's transpose reduce-scatters the weight gradients
+    back.  Without it a pipe×fsdp mesh keeps each stage's FULL weights
+    resident per chip and GSPMD re-gathers at the shard_map boundary —
+    correct, but no ZeRO-3 memory saving.  Exact same math either way
+    (the gather reconstructs the full weights bit-for-bit).
+
+    Pair with ``remat=True`` when the MEMORY saving is the point: without
+    remat the backward saves each tick's gathered full-width weights as
+    scan residuals, so peak HBM still holds full stage weights; the
+    remat'd tick re-gathers in backward instead of saving.
     """
     from distributeddeeplearning_tpu.ops.pipeline import pipeline_apply
 
@@ -240,15 +261,49 @@ def forward_pipelined(
         lambda a: a.reshape(n_stages, L // n_stages, *a.shape[1:]), blocks
     )
 
-    def stage_fn(stage_params, x):
-        return _stack_scan(
-            stage_params, x, num_heads=num_heads, attention=attention
-        )
+    param_partition = None
+    if zero3_axis is not None and int(mesh.shape[zero3_axis]) > 1:
+        t = int(mesh.shape[zero3_axis])
+        for name, dim in _ZERO3_WIDTH_DIM.items():
+            # leaf layout [S, L/S, ...]: param_partition dim indexes skip
+            # the stage dim, the staged leaf adds one more leading dim
+            width = staged[name].shape[dim + 1]
+            if width % t:
+                raise ValueError(
+                    f"{zero3_axis}={t} must divide {name}'s sharded width "
+                    f"{width}"
+                )
+        param_partition = {
+            name: tuple(
+                zero3_axis if d == dim else None for d in range(3)
+            )
+            for name, dim in _ZERO3_WIDTH_DIM.items()
+        }
+        param_partition["ln1"] = None
+        param_partition["ln2"] = None
+
+        def stage_fn(stage_params, x):
+            gathered = {
+                k: jax.lax.all_gather(
+                    v, zero3_axis, axis=_ZERO3_WIDTH_DIM[k], tiled=True
+                )
+                if k in _ZERO3_WIDTH_DIM
+                else v
+                for k, v in stage_params.items()
+            }
+            return _stack_scan(
+                gathered, x, num_heads=num_heads, attention=attention
+            )
+    else:
+        def stage_fn(stage_params, x):
+            return _stack_scan(
+                stage_params, x, num_heads=num_heads, attention=attention
+            )
 
     x = _embed(params, tokens)
     x = pipeline_apply(
         stage_fn, staged, x, mesh=mesh, num_microbatches=num_microbatches,
-        remat=remat,
+        remat=remat, param_partition=param_partition,
     )
     return x @ params["head"]
 

@@ -266,10 +266,15 @@ def main(
                 loss_chunk=loss_chunk, unroll=scan_unroll,
             )
         elif pipe > 1:
+            # pipe×fsdp: ZeRO-3 width shards live inside the pipeline
+            # stages (gathered per tick); with --tensor the width dims
+            # belong to the tensor axis instead and GSPMD handles the
+            # boundary resharding.
             out = forward_pipelined(
                 p, tokens, num_heads=num_heads, mesh=mesh,
                 num_microbatches=num_microbatches, remat=remat,
                 attention=attention,
+                zero3_axis="fsdp" if fsdp > 1 and tensor == 1 else None,
             ).astype(jnp.float32)
         else:
             out = forward(p, tokens, num_heads=num_heads,
