@@ -159,8 +159,9 @@ def test_lm_ulysses_flash_all_levers():
 
 def test_lm_pipe_composes_with_fsdp():
     """pipe=2 x fsdp=2 x data=2 on the 8-device pod: GPipe stages with
-    ZeRO-sharded embed/head/width params (XLA reshards at the pipeline
-    shard_map boundary)."""
+    ZeRO-3 width shards living INSIDE the pipeline (the workload wires
+    forward_pipelined(zero3_axis='fsdp'): per-tick weight all-gathers via
+    param_partition; embed/head vocab shards stay on the GSPMD rules)."""
     state, fit = lm_main(pipe=2, fsdp=2, num_microbatches=2, **TINY)
     assert np.isfinite(fit.final_train_metrics["loss"])
 
