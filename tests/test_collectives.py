@@ -412,3 +412,75 @@ def test_fsdp_lm_emits_param_allgathers():
         hlo, "all-gather-start"
     )
     assert ag, "fsdp LM compiled without any param all-gather"
+
+
+def test_zero3_pipeline_lm_emits_per_tick_gathers_and_grad_scatter():
+    """pipe×fsdp with zero3_axis: the compiled step must all-gather the
+    width-sharded stage weights for compute (per-tick ZeRO-3 gathers) and
+    reduce-scatter their gradients back (the gather's transpose) — the
+    signature that distinguishes true in-stage ZeRO-3 from GSPMD boundary
+    resharding of replicated stage weights."""
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        forward_pipelined,
+        init_params,
+        next_token_loss,
+    )
+    from distributeddeeplearning_tpu.train.state import TrainState
+
+    mesh = create_mesh(
+        MeshSpec(pipe=2, fsdp=2), devices=jax.devices()[:N_DEV]
+    )
+    params = init_params(
+        jax.random.key(0), num_layers=2, d_model=32, num_heads=2, d_ff=64,
+        vocab_size=64, max_len=16,
+    )
+
+    def apply_fn(variables, tokens, train=True, mutable=None, rngs=None):
+        logits = forward_pipelined(
+            variables["params"], tokens, num_heads=2, mesh=mesh,
+            num_microbatches=2, zero3_axis="fsdp",
+        )
+        if mutable is not None:
+            return logits, {}
+        return logits
+
+    tx = optax.sgd(0.1)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        opt_state=tx.init(params), batch_stats={}, apply_fn=apply_fn, tx=tx,
+    )
+    rules = [("layers", "pipe"), ("vocab", "fsdp"), ("width", "fsdp")]
+    axes = {
+        "embed": ("vocab", None),
+        "pos": None,
+        "head": (None, "vocab"),
+        "blocks": {
+            "qkv": ("layers", None, "width"),
+            "proj": ("layers", "width", None),
+            "w_in": ("layers", None, "width"),
+            "w_out": ("layers", "width", None),
+            "ln1": ("layers", None),
+            "ln2": ("layers", None),
+        },
+    }
+    step = build_train_step(
+        mesh, state, compute_dtype=jnp.float32, rules=rules,
+        logical_axes=axes,
+        loss_fn=lambda lg, lb, label_smoothing=0.0: next_token_loss(lg, lb),
+        metrics_fn=lambda lg, lb, loss: {"loss": loss.astype(jnp.float32)},
+    )
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, (2 * N_DEV, 16)).astype(np.int32)
+    batch = shard_batch(mesh, {"input": toks, "label": toks})
+    hlo = compiled_hlo(step, state, batch)
+    ag = collective_ops(hlo, "all-gather") + collective_ops(
+        hlo, "all-gather-start"
+    )
+    assert ag, "zero3 pipeline compiled without weight all-gathers"
+    rs = collective_ops(hlo, "reduce-scatter") + collective_ops(
+        hlo, "reduce-scatter-start"
+    )
+    assert rs, (
+        "zero3 pipeline compiled without a gradient reduce-scatter "
+        "(the all-gather transpose)"
+    )
