@@ -721,7 +721,7 @@ def _run_scaling(args) -> int:
     still collected but only as an explicitly-labeled debug column.
     """
     from distributeddeeplearning_tpu.utils.virtual_pod import (
-        force_cpu_platform_if_child,
+        force_cpu_platform_if_virtual_pod,
         is_reexec_child,
         reexec_with_virtual_pod,
     )
@@ -735,7 +735,7 @@ def _run_scaling(args) -> int:
 
     import jax
 
-    force_cpu_platform_if_child()
+    force_cpu_platform_if_virtual_pod()
     if len(jax.devices()) < max(sizes):
         return reexec_with_virtual_pod(max(sizes))
 

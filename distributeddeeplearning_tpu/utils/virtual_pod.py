@@ -26,20 +26,46 @@ def is_reexec_child() -> bool:
     return os.environ.get(SENTINEL) == "1"
 
 
-def force_cpu_platform_if_child() -> None:
-    """In a re-exec'd child, pin the CPU platform before backend init.
+def force_cpu_platform_if_virtual_pod() -> None:
+    """Pin the CPU platform before backend init when a virtual pod was
+    requested — by the re-exec sentinel OR by an
+    ``--xla_force_host_platform_device_count`` already present in
+    ``XLA_FLAGS`` (the documented external-driver recipe).  Honoring the
+    flag directly matters on this box: the site hook pins the hardware
+    plugin, and querying it first would hang the whole process whenever
+    the TPU tunnel is down even though the caller only wanted CPUs.
 
-    Must run before the first ``jax.devices()``/array op; a no-op in the
-    parent or when the backend is already initialized.
+    The flag-triggered path fires in the PARENT process too (not just
+    re-exec children), so it announces itself on stderr — a stale
+    exported XLA_FLAGS must not silently downgrade a real-hardware run.
+
+    Must run before the first ``jax.devices()``/array op; a no-op
+    otherwise or when the backend is already initialized.
     """
+    flag_requested = (
+        "xla_force_host_platform_device_count"
+        in os.environ.get("XLA_FLAGS", "")
+    )
     if not is_reexec_child():
-        return
+        if not flag_requested:
+            return
+        print(
+            "[virtual_pod] XLA_FLAGS requests "
+            "xla_force_host_platform_device_count: pinning the CPU "
+            "platform (unset the flag to use real devices)",
+            file=sys.stderr,
+        )
     import jax
 
     try:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass  # backend already initialized; the caller's count check decides
+
+
+# Back-compat alias for the pre-r5 name (child-only semantics grew into
+# the virtual-pod trigger above).
+force_cpu_platform_if_child = force_cpu_platform_if_virtual_pod
 
 
 def reexec_with_virtual_pod(

@@ -356,25 +356,40 @@ def test_causal_ring_lm_emits_collective_permutes():
     assert cp, "causal ring LM compiled without collective-permute"
 
 
-def test_fsdp_lm_emits_param_allgathers():
-    """--fsdp on the LM workload: sharded embed/head/FF params must be
-    all-gathered for compute (ZeRO-3 signature) rather than silently
-    replicated."""
+
+_LM_LOGICAL_AXES = {
+    "embed": ("vocab", None),
+    "pos": None,
+    "head": (None, "vocab"),
+    "blocks": {
+        "qkv": ("layers", None, "width"),
+        "proj": ("layers", "width", None),
+        "w_in": ("layers", None, "width"),
+        "w_out": ("layers", "width", None),
+        "ln1": ("layers", None),
+        "ln2": ("layers", None),
+    },
+}
+
+
+def _lm_step_hlo(mesh, forward_fn):
+    """Compiled HLO of a full LM train step: shared scaffolding for the
+    LM collective-signature tests (one copy of the TrainState / rules /
+    logical-axes boilerplate; ``forward_fn(params, tokens)`` decides the
+    parallel forward under test)."""
     from distributeddeeplearning_tpu.models.pipelined_transformer import (
-        forward,
         init_params,
         next_token_loss,
     )
     from distributeddeeplearning_tpu.train.state import TrainState
 
-    mesh = create_mesh(MeshSpec(fsdp=N_DEV), devices=jax.devices()[:N_DEV])
     params = init_params(
         jax.random.key(0), num_layers=2, d_model=32, num_heads=2, d_ff=64,
         vocab_size=64, max_len=16,
     )
 
     def apply_fn(variables, tokens, train=True, mutable=None, rngs=None):
-        logits = forward(variables["params"], tokens, num_heads=2)
+        logits = forward_fn(variables["params"], tokens)
         if mutable is not None:
             return logits, {}
         return logits
@@ -384,30 +399,29 @@ def test_fsdp_lm_emits_param_allgathers():
         step=jnp.zeros((), jnp.int32), params=params,
         opt_state=tx.init(params), batch_stats={}, apply_fn=apply_fn, tx=tx,
     )
-    rules = [("layers", "pipe"), ("vocab", "fsdp"), ("width", "fsdp")]
-    axes = {
-        "embed": ("vocab", None),
-        "pos": None,
-        "head": (None, "vocab"),
-        "blocks": {
-            "qkv": ("layers", None, "width"),
-            "proj": ("layers", "width", None),
-            "w_in": ("layers", None, "width"),
-            "w_out": ("layers", "width", None),
-            "ln1": ("layers", None),
-            "ln2": ("layers", None),
-        },
-    }
     step = build_train_step(
-        mesh, state, compute_dtype=jnp.float32, rules=rules,
-        logical_axes=axes,
+        mesh, state, compute_dtype=jnp.float32,
+        rules=[("layers", "pipe"), ("vocab", "fsdp"), ("width", "fsdp")],
+        logical_axes=_LM_LOGICAL_AXES,
         loss_fn=lambda lg, lb, label_smoothing=0.0: next_token_loss(lg, lb),
         metrics_fn=lambda lg, lb, loss: {"loss": loss.astype(jnp.float32)},
     )
     rng = np.random.default_rng(0)
     toks = rng.integers(0, 64, (2 * N_DEV, 16)).astype(np.int32)
     batch = shard_batch(mesh, {"input": toks, "label": toks})
-    hlo = compiled_hlo(step, state, batch)
+    return compiled_hlo(step, state, batch)
+
+
+def test_fsdp_lm_emits_param_allgathers():
+    """--fsdp on the LM workload: sharded embed/head/FF params must be
+    all-gathered for compute (ZeRO-3 signature) rather than silently
+    replicated."""
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        forward,
+    )
+
+    mesh = create_mesh(MeshSpec(fsdp=N_DEV), devices=jax.devices()[:N_DEV])
+    hlo = _lm_step_hlo(mesh, lambda p, t: forward(p, t, num_heads=2))
     ag = collective_ops(hlo, "all-gather") + collective_ops(
         hlo, "all-gather-start"
     )
@@ -415,64 +429,29 @@ def test_fsdp_lm_emits_param_allgathers():
 
 
 def test_zero3_pipeline_lm_emits_per_tick_gathers_and_grad_scatter():
-    """pipe×fsdp with zero3_axis: the compiled step must all-gather the
-    width-sharded stage weights for compute (per-tick ZeRO-3 gathers) and
-    reduce-scatter their gradients back (the gather's transpose) — the
-    signature that distinguishes true in-stage ZeRO-3 from GSPMD boundary
-    resharding of replicated stage weights."""
+    """pipe×fsdp with zero3_axis: the compiled step must contain weight
+    all-gathers and the gather-transpose gradient reduce-scatter.  (A
+    pipe×fsdp step WITHOUT zero3_axis also gathers at the shard_map
+    boundary, so presence alone does not prove the per-tick path — the
+    in-stage wiring itself is pinned by
+    tests/test_pipelined_transformer.py::test_zero3_wires_param_partition
+    and the math by ...::test_zero3_pipelined_matches_sequential; this
+    test pins the end-to-end collective signature of the full train
+    step.)"""
     from distributeddeeplearning_tpu.models.pipelined_transformer import (
         forward_pipelined,
-        init_params,
-        next_token_loss,
     )
-    from distributeddeeplearning_tpu.train.state import TrainState
 
     mesh = create_mesh(
         MeshSpec(pipe=2, fsdp=2), devices=jax.devices()[:N_DEV]
     )
-    params = init_params(
-        jax.random.key(0), num_layers=2, d_model=32, num_heads=2, d_ff=64,
-        vocab_size=64, max_len=16,
+    hlo = _lm_step_hlo(
+        mesh,
+        lambda p, t: forward_pipelined(
+            p, t, num_heads=2, mesh=mesh, num_microbatches=2,
+            zero3_axis="fsdp",
+        ),
     )
-
-    def apply_fn(variables, tokens, train=True, mutable=None, rngs=None):
-        logits = forward_pipelined(
-            variables["params"], tokens, num_heads=2, mesh=mesh,
-            num_microbatches=2, zero3_axis="fsdp",
-        )
-        if mutable is not None:
-            return logits, {}
-        return logits
-
-    tx = optax.sgd(0.1)
-    state = TrainState(
-        step=jnp.zeros((), jnp.int32), params=params,
-        opt_state=tx.init(params), batch_stats={}, apply_fn=apply_fn, tx=tx,
-    )
-    rules = [("layers", "pipe"), ("vocab", "fsdp"), ("width", "fsdp")]
-    axes = {
-        "embed": ("vocab", None),
-        "pos": None,
-        "head": (None, "vocab"),
-        "blocks": {
-            "qkv": ("layers", None, "width"),
-            "proj": ("layers", "width", None),
-            "w_in": ("layers", None, "width"),
-            "w_out": ("layers", "width", None),
-            "ln1": ("layers", None),
-            "ln2": ("layers", None),
-        },
-    }
-    step = build_train_step(
-        mesh, state, compute_dtype=jnp.float32, rules=rules,
-        logical_axes=axes,
-        loss_fn=lambda lg, lb, label_smoothing=0.0: next_token_loss(lg, lb),
-        metrics_fn=lambda lg, lb, loss: {"loss": loss.astype(jnp.float32)},
-    )
-    rng = np.random.default_rng(0)
-    toks = rng.integers(0, 64, (2 * N_DEV, 16)).astype(np.int32)
-    batch = shard_batch(mesh, {"input": toks, "label": toks})
-    hlo = compiled_hlo(step, state, batch)
     ag = collective_ops(hlo, "all-gather") + collective_ops(
         hlo, "all-gather-start"
     )

@@ -279,3 +279,56 @@ def test_zero3_pipelined_matches_sequential():
         g_pipe,
         g_seq,
     )
+
+
+def test_zero3_wires_param_partition(monkeypatch):
+    """forward_pipelined(zero3_axis=...) must hand pipeline_apply a width
+    param_partition (the in-stage ZeRO-3 mechanism) and None without it —
+    the wiring a boundary-reshard regression would silently drop."""
+    from distributeddeeplearning_tpu.ops import pipeline as pipeline_mod
+
+    captured = {}
+    real = pipeline_mod.pipeline_apply
+
+    def spy(*args, **kwargs):
+        captured["param_partition"] = kwargs.get("param_partition")
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(pipeline_mod, "pipeline_apply", spy)
+    mesh = create_mesh(MeshSpec(pipe=2, fsdp=2))
+    params = init_params(
+        jax.random.key(0), num_layers=2, d_model=32, num_heads=2, d_ff=64,
+        vocab_size=64, max_len=16,
+    )
+    toks = jnp.zeros((8, 16), jnp.int32)
+
+    forward_pipelined(
+        params, toks, num_heads=2, mesh=mesh, num_microbatches=2,
+        zero3_axis="fsdp",
+    )
+    part = captured["param_partition"]
+    assert part["qkv"] == (None, None, "fsdp")
+    assert part["proj"] == (None, "fsdp", None)
+    assert part["w_in"] == (None, None, "fsdp")
+    assert part["w_out"] == (None, "fsdp", None)
+    assert part["ln1"] is None and part["ln2"] is None
+
+    forward_pipelined(
+        params, toks, num_heads=2, mesh=mesh, num_microbatches=2,
+    )
+    assert captured["param_partition"] is None
+
+
+def test_zero3_rejects_indivisible_width():
+    import pytest
+
+    mesh = create_mesh(MeshSpec(pipe=2, fsdp=4))
+    params = init_params(
+        jax.random.key(0), num_layers=2, d_model=6, num_heads=2, d_ff=10,
+        vocab_size=64, max_len=16,
+    )
+    with pytest.raises(ValueError, match="must divide"):
+        forward_pipelined(
+            params, jnp.zeros((8, 16), jnp.int32), num_heads=2, mesh=mesh,
+            num_microbatches=2, zero3_axis="fsdp",
+        )
