@@ -45,9 +45,11 @@ bench:
 scaling:
 	python bench.py --devices 1,2,4,8 --small
 
-# Multi-chip sharding dry run on a virtual 8-device pod.
+# Multi-chip sharding dry run on a virtual 8-device pod (the XLA_FLAGS
+# hint lets utils/virtual_pod pin the CPU platform without touching the
+# hardware plugin, so this works even when the TPU tunnel is down).
 dryrun:
-	python __graft_entry__.py 8
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 python __graft_entry__.py 8
 
 # ---- Control-plane container lifecycle ({{proj}}/Makefile:27-53 parity) ----
 
