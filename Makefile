@@ -49,7 +49,7 @@ scaling:
 # hint lets utils/virtual_pod pin the CPU platform without touching the
 # hardware plugin, so this works even when the TPU tunnel is down).
 dryrun:
-	XLA_FLAGS=--xla_force_host_platform_device_count=8 python __graft_entry__.py 8
+	XLA_FLAGS="$$XLA_FLAGS --xla_force_host_platform_device_count=8" python __graft_entry__.py 8
 
 # ---- Control-plane container lifecycle ({{proj}}/Makefile:27-53 parity) ----
 

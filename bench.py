@@ -912,6 +912,57 @@ def main() -> int:
         enable_compilation_cache,
     )
 
+    import os
+
+    from distributeddeeplearning_tpu.utils.virtual_pod import (
+        force_cpu_platform_if_virtual_pod,
+        is_reexec_child,
+        reexec_with_virtual_pod,
+    )
+
+    # When a virtual pod was requested (sentinel or XLA_FLAGS hint) this
+    # pins the CPU platform for EVERY bench path before the first backend
+    # query — without it the site hook's hardware plugin would be queried
+    # (and would hang forever on a dead tunnel) even though the caller
+    # only wanted CPUs.
+    force_cpu_platform_if_virtual_pod()
+    virtual_pod = is_reexec_child() or (
+        "xla_force_host_platform_device_count"
+        in os.environ.get("XLA_FLAGS", "")
+    )
+    if not virtual_pod:
+        reachable, probe_error = _backend_reachable(timeout_s=180.0)
+        if not reachable and args.devices:
+            # The scaling sweep's quotable output (compiled-HLO collective
+            # signatures) is platform-independent and designed for the
+            # virtual pod — fall back to it rather than aborting.
+            sizes = [int(x) for x in args.devices.split(",")]
+            print(
+                "[bench] hardware backend unreachable; re-running the "
+                "--devices sweep on a virtual CPU pod",
+                file=sys.stderr,
+            )
+            return reexec_with_virtual_pod(max(sizes))
+        if not reachable:
+            # Fail LOUD and fast instead of hanging forever: the tunneled
+            # TPU backend blocks indefinitely inside the first device
+            # query when the tunnel is down, and a hang leaves the driver
+            # with no record at all.  One diagnostic JSON line keeps the
+            # artifact contract.
+            print(
+                json.dumps(
+                    {
+                        "metric": f"{args.model}_bench_unavailable",
+                        "value": None,
+                        "unit": None,
+                        "vs_baseline": None,
+                        "error": probe_error
+                        or "TPU backend unreachable: jax.devices() did "
+                        "not return within 180s (tunnel down?)",
+                    }
+                )
+            )
+            return 1
     enable_compilation_cache()
     if args.devices:
         return _run_scaling(args)
@@ -920,6 +971,36 @@ def main() -> int:
     if args.data:
         return _run_data(args)
     return _run_single(args)
+
+
+def _backend_reachable(timeout_s: float):
+    """(ok, error_or_None): does the default backend answer a device query?
+
+    The probe runs in a daemon thread because a dead tunnel blocks the
+    query in C++ (no Python-level interrupt works); the thread is leaked
+    on timeout, which is fine — the process exits right after.  A probe
+    that RAISED (misconfigured platform, broken plugin) is reported with
+    its real exception rather than masquerading as a timeout.
+    """
+    import threading
+
+    outcome = []
+
+    def probe():
+        try:
+            import jax
+
+            jax.devices()
+            outcome.append((True, None))
+        except Exception as e:  # noqa: BLE001 — reported verbatim
+            outcome.append((False, f"backend init raised: {e!r}"))
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not outcome:
+        return False, None  # timed out — the generic tunnel-down message
+    return outcome[0]
 
 
 if __name__ == "__main__":
