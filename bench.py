@@ -38,6 +38,14 @@ import sys
 V100_TF_CNN_BENCHMARKS_IMG_SEC = 720.0
 
 
+def _is_virtual_pod() -> bool:
+    """Recorded in every artifact so CPU numbers can never masquerade as
+    hardware — one definition, shared with ``ddlt serve``."""
+    from distributeddeeplearning_tpu.utils.virtual_pod import is_virtual_pod
+
+    return is_virtual_pod()
+
+
 def _build_bert_bench(args, devices=None):
     """BERT fine-tune step benchmark (BASELINE.md's tracked transformer
     config): AdamW, bf16, full-length synthetic token batch, --seq-len."""
@@ -414,6 +422,11 @@ def _run_single(args) -> int:
         "vs_baseline": None if (is_bert or is_lm or is_vit) else round(
             result.img_sec_per_chip_mean / V100_TF_CNN_BENCHMARKS_IMG_SEC, 3
         ),
+        # A CPU-downgraded run (stale XLA_FLAGS virtual-pod hint, re-exec
+        # child) must be distinguishable from a hardware run IN THE
+        # ARTIFACT, not just on stderr — same fields _run_scaling records.
+        "platform": jax.default_backend(),
+        "virtual_pod": _is_virtual_pod(),
     }
     if mfu is not None:
         line["mfu"] = round(mfu, 4)
@@ -657,6 +670,108 @@ def _run_roofline(args) -> int:
     return 0
 
 
+def _run_serve(args) -> int:
+    """Serving benchmark: the KV-cached engine under continuous batching.
+
+    Builds the causal LM at the same dims as ``--model lm`` (``--small``
+    shrinks it), admits ``--serve-requests`` synthetic prompts (more than
+    ``--batch-slots``, so slot release/reuse is exercised) and emits ONE
+    JSON line — the ``SERVE_*.json`` artifact: generated tokens/s, TTFT
+    p50/p99, per-decode-step latency, mean slot occupancy, platform +
+    virtual_pod provenance.
+    """
+    import jax
+    import numpy as np
+
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        init_params,
+    )
+    from distributeddeeplearning_tpu.serve import (
+        ContinuousBatchingScheduler,
+        Request,
+        cache_bytes,
+        data_parallel_engine,
+        synthetic_requests,
+    )
+
+    dims = dict(num_layers=12, d_model=768, num_heads=12, d_ff=3072,
+                vocab_size=32768)
+    if args.small:
+        dims = dict(num_layers=2, d_model=64, num_heads=4, d_ff=128,
+                    vocab_size=257)
+    max_prompt = max(8, args.seq_len)
+    max_seq = max_prompt + args.max_new_tokens
+    params = init_params(jax.random.key(0), max_len=max_seq, **dims)
+
+    n_dev = len(jax.devices())
+    engine, mesh = data_parallel_engine(
+        params,
+        num_heads=dims["num_heads"],
+        batch_slots=args.batch_slots,
+        max_seq=max_seq,
+        prefill_attention="flash" if args.attention == "flash" else "dense",
+        temperature=args.serve_temperature,
+        rng=jax.random.key(1),
+    )
+    requests = synthetic_requests(
+        args.serve_requests, vocab_size=dims["vocab_size"],
+        max_prompt=max_prompt, min_prompt=max_prompt // 2,
+        rng=np.random.default_rng(0),
+    )
+    scheduler = ContinuousBatchingScheduler(
+        engine, max_new_tokens=args.max_new_tokens
+    )
+    # warmup: compile EVERY prefill bucket the request set will hit plus
+    # the decode step, so the timed run measures serving, not XLA — one
+    # prompt per distinct bucket (lengths span two power-of-two buckets
+    # in the default config).  Budget THREE tokens: the first comes from
+    # prefill at admission (a 1-token budget never decodes at all), and
+    # the donated-cache decode needs TWO steps to reach steady state —
+    # the first call compiles, the second recompiles with the output
+    # layouts fed back as input layouts (the layout-donation double
+    # compile, same as the train step).
+    from distributeddeeplearning_tpu.serve.engine import prompt_bucket
+
+    buckets = {}
+    for r in requests:
+        buckets.setdefault(prompt_bucket(len(r.prompt), max_seq), r.prompt)
+    _, warm_report = ContinuousBatchingScheduler(
+        engine, max_new_tokens=3
+    ).run([
+        Request(uid=f"warmup{i}", prompt=p)
+        for i, p in enumerate(buckets.values())
+    ])
+    assert warm_report.decode_steps >= 2, "warmup never reached decode"
+    results, report = scheduler.run(requests)
+
+    # One schema with ddlt serve's --report (ServeReport.to_dict(), the
+    # README-documented keys) plus the bench-line headline fields and
+    # ms-denominated conveniences.
+    line = {
+        "metric": f"lm_serve_{args.attention}_tok_sec",
+        "value": report.tokens_per_sec,
+        "unit": "tok/sec",
+        "vs_baseline": None,
+        **report.to_dict(),
+        "ttft_ms": {
+            "p50": round(report.ttft_s["p50"] * 1e3, 2),
+            "p99": round(report.ttft_s["p99"] * 1e3, 2),
+        },
+        "decode_step_ms": {
+            "p50": round(report.decode_step_s["p50"] * 1e3, 3),
+            "p99": round(report.decode_step_s["p99"] * 1e3, 3),
+        },
+        "max_new_tokens": args.max_new_tokens,
+        "max_prompt_len": max_prompt,
+        "kv_cache_mb": round(cache_bytes(engine.cache) / 1e6, 3),
+        "mesh_devices": n_dev if mesh is not None else 1,
+        "platform": jax.default_backend(),
+        "virtual_pod": _is_virtual_pod(),
+    }
+    print(json.dumps(line))
+    return 0
+
+
 _COLLECTIVE_OPS = (
     "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
     "all-to-all",
@@ -669,15 +784,21 @@ def _collective_stats(hlo_text: str):
     issues per step and how many bytes each moves (output-shape bytes).
 
     ``-start`` variants count once (their ``-done`` twin carries no new
-    traffic); ``-done`` and region parameter lines are skipped.
+    traffic); ``-done`` and region parameter lines are skipped.  An async
+    ``-start``'s tuple signature aliases ``(operands…, results…)``, so
+    only the result half is summed — halving the whole tuple is exact only
+    for equal-size collectives and under-reports all-gather-start /
+    reduce-scatter-start by the axis-size factor (their operand and result
+    differ by exactly that factor).
     """
     import re
 
     bpe = {"f32": 4, "bf16": 2, "s32": 4, "u32": 4, "f16": 2, "u8": 1,
            "s8": 1, "pred": 1, "f64": 8, "s64": 8, "u64": 8}
 
-    def shape_bytes(sig: str) -> int:
-        total = 0
+    def shape_bytes_list(sig: str):
+        """[(bytes, is_scalar)] per array shape in an HLO signature."""
+        out = []
         for m in re.finditer(r"(\w+)\[([0-9,]*)\]", sig):
             if m.group(1) not in bpe:
                 continue
@@ -685,8 +806,8 @@ def _collective_stats(hlo_text: str):
             for d in m.group(2).split(","):
                 if d:
                     n *= int(d)
-            total += n * bpe[m.group(1)]
-        return total
+            out.append((n * bpe[m.group(1)], not m.group(2)))
+        return out
 
     stats = {op: {"count": 0, "bytes": 0} for op in _COLLECTIVE_OPS}
     for line in hlo_text.splitlines():
@@ -698,12 +819,20 @@ def _collective_stats(hlo_text: str):
         base = op[:-len("-start")] if op.endswith("-start") else op
         if base not in stats or op.endswith("-done"):
             continue
-        nbytes = shape_bytes(m.group(1))
+        shapes = shape_bytes_list(m.group(1))
         if op.endswith("-start") and m.group(1).startswith("("):
-            # async start tuples alias (operands…, results…); halve so the
-            # moved tensor isn't counted twice (exact for the equal-size
-            # collectives; all-reduce/permute/all-to-all)
-            nbytes //= 2
+            # (operands…, results…[, context scalars]): the result half is
+            # the moved (output-shape) traffic — exact for unequal-size
+            # collectives like all-gather-start too, where halving the
+            # whole tuple under-reports by the axis-size factor.  u32[]
+            # context scalars are bookkeeping, not traffic.
+            arrays = [b for b, scalar in shapes if not scalar]
+            if arrays and len(arrays) % 2 == 0:
+                nbytes = sum(arrays[len(arrays) // 2:])
+            else:  # odd layout — halving is the best approximation left
+                nbytes = sum(arrays) // 2
+        else:
+            nbytes = sum(b for b, _ in shapes)
         stats[base]["count"] += 1
         stats[base]["bytes"] += nbytes
     return {op: s for op, s in stats.items() if s["count"]}
@@ -873,6 +1002,38 @@ def main() -> int:
         help="steps to trace for --roofline",
     )
     parser.add_argument(
+        "--serve",
+        action="store_true",
+        help="benchmark the KV-cached serving engine (serve/) under "
+        "continuous batching instead of a train step; emits the "
+        "SERVE_*.json line (tok/s, TTFT p50/p99, slot occupancy)",
+    )
+    parser.add_argument(
+        "--serve-requests",
+        type=int,
+        default=12,
+        help="synthetic requests for --serve (keep > --batch-slots so "
+        "slot release/reuse is exercised)",
+    )
+    parser.add_argument(
+        "--batch-slots",
+        type=int,
+        default=4,
+        help="KV-cache slots (the decode batch) for --serve",
+    )
+    parser.add_argument(
+        "--max-new-tokens",
+        type=int,
+        default=16,
+        help="per-request generation budget for --serve",
+    )
+    parser.add_argument(
+        "--serve-temperature",
+        type=float,
+        default=0.0,
+        help="sampling temperature for --serve (0 = greedy)",
+    )
+    parser.add_argument(
         "--data",
         default=None,
         choices=("tfrecords", "native", "raw"),
@@ -900,6 +1061,10 @@ def main() -> int:
     args = parser.parse_args()
     if args.fit and args.model == "lm":
         parser.error("--fit is not supported for --model lm")
+    if args.serve and args.devices:
+        # the scaling dispatch would otherwise win silently and emit a
+        # wrong-schema artifact where the caller scripted a SERVE one
+        parser.error("--serve and --devices are mutually exclusive")
 
     if args.small:
         args.batch_size, args.image_size = 16, 64
@@ -912,11 +1077,8 @@ def main() -> int:
         enable_compilation_cache,
     )
 
-    import os
-
     from distributeddeeplearning_tpu.utils.virtual_pod import (
         force_cpu_platform_if_virtual_pod,
-        is_reexec_child,
         reexec_with_virtual_pod,
     )
 
@@ -926,10 +1088,7 @@ def main() -> int:
     # (and would hang forever on a dead tunnel) even though the caller
     # only wanted CPUs.
     force_cpu_platform_if_virtual_pod()
-    virtual_pod = is_reexec_child() or (
-        "xla_force_host_platform_device_count"
-        in os.environ.get("XLA_FLAGS", "")
-    )
+    virtual_pod = _is_virtual_pod()
     if not virtual_pod:
         reachable, probe_error = _backend_reachable(timeout_s=180.0)
         if not reachable and args.devices:
@@ -966,6 +1125,8 @@ def main() -> int:
     enable_compilation_cache()
     if args.devices:
         return _run_scaling(args)
+    if args.serve:
+        return _run_serve(args)
     if args.roofline:
         return _run_roofline(args)
     if args.data:

@@ -222,7 +222,20 @@ def build_parser() -> argparse.ArgumentParser:
                       choices=("train", "validation"))
     bc_p.add_argument("--image-size", type=int, default=224)
     bc_p.add_argument("--cache-dir", default=None,
-                      help="default: <data-dir>/raw-cache-<split>-<size>")
+                      help="default: <data-dir>/raw-cache-<split>-<size>"
+                      "[-shardIofN with --shard-count] — the exact dir a "
+                      "run with the same shard settings will look for")
+    bc_p.add_argument(
+        "--shard-count", type=int, default=1,
+        help="total hosts of the multi-host run this cache is for; "
+        "multi-host imagenet runs read per-host '-shardIofN'-suffixed "
+        "cache dirs, so pre-build one per host (default 1: single-host, "
+        "unsuffixed)",
+    )
+    bc_p.add_argument(
+        "--shard-index", type=int, default=0,
+        help="which host's slice to build (0-based, with --shard-count)",
+    )
     vm_p = st_sub.add_parser(
         "val-maps",
         help="Derive imagenet_val_maps.csv from the ILSVRC2012 devkit tar "
@@ -263,6 +276,64 @@ def build_parser() -> argparse.ArgumentParser:
     _add_submit_tree(sub, "transformer", formats=("synthetic",))
     _add_submit_tree(sub, "benchmark", formats=("synthetic",))
     _add_submit_tree(sub, "experiment", formats=())
+
+    serve_p = sub.add_parser(
+        "serve",
+        help="KV-cached autoregressive inference with continuous batching "
+        "(serve/): prompts from stdin/--prompt-file as token-id lines, or "
+        "--synthetic",
+    )
+    src = serve_p.add_mutually_exclusive_group()
+    src.add_argument(
+        "--prompt-file", default=None,
+        help="file of prompts, one per line as whitespace-separated token "
+        "ids ('-' = stdin; default: stdin when piped)",
+    )
+    src.add_argument(
+        "--synthetic", action="store_true",
+        help="generate --requests random prompts (benchmark mode; stats "
+        "JSON goes to stdout)",
+    )
+    serve_p.add_argument("--requests", type=int, default=12,
+                         help="synthetic request count (keep > --batch-slots "
+                         "so continuous batching reuses slots)")
+    serve_p.add_argument("--prompt-len", type=int, default=16,
+                         help="max synthetic prompt length")
+    serve_p.add_argument("--batch-slots", type=int, default=4,
+                         help="KV-cache slots (the decode batch width)")
+    serve_p.add_argument("--max-new-tokens", type=int, default=32)
+    serve_p.add_argument("--max-seq", type=int, default=None,
+                         help="cache length per slot (default: prompt cap + "
+                         "--max-new-tokens)")
+    serve_p.add_argument("--temperature", type=float, default=0.0,
+                         help="0 = greedy (deterministic)")
+    serve_p.add_argument("--top-k", type=int, default=None)
+    serve_p.add_argument("--eos-id", type=int, default=None,
+                         help="token id that ends a sequence early")
+    serve_p.add_argument("--seed", type=int, default=0,
+                         help="sampling RNG seed (step-folded per draw)")
+    serve_p.add_argument("--checkpoint-dir", default=None,
+                         help="orbax checkpoint dir (train/checkpoint.py); "
+                         "restores the latest step's params")
+    serve_p.add_argument("--prefill-attention", default="flash",
+                         choices=("flash", "dense"),
+                         help="prompt-pass attention (decode is always "
+                         "dense against the cache)")
+    serve_p.add_argument("--report", default=None,
+                         help="also write the stats JSON here "
+                         "(e.g. SERVE_r06.json)")
+    for flag, default in (("--num-layers", 2), ("--d-model", 64),
+                          ("--d-ff", 128), ("--vocab-size", 257)):
+        serve_p.add_argument(flag, type=int, default=default,
+                             help="model dim (ignored with --checkpoint-dir"
+                             " — dims come from the restored params)")
+    serve_p.add_argument(
+        "--num-heads", type=int, default=None,
+        help="attention heads (default 4).  REQUIRED with "
+        "--checkpoint-dir: the head count is not derivable from the "
+        "saved qkv shapes, and a wrong-but-dividing value generates "
+        "garbage silently",
+    )
 
     inter_p = sub.add_parser(
         "interactive",
@@ -580,6 +651,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.command == "tpu":
         return _cmd_tpu(args)
+    if args.command == "serve":
+        return _cmd_serve(args)
     if args.command == "storage":
         return _cmd_storage(args)
     if args.command in (
@@ -749,6 +822,205 @@ def _cmd_setup(args) -> int:
     return 0
 
 
+def _read_prompts(args):
+    """[(uid, token-id list)] from --prompt-file / stdin (one prompt per
+    line, whitespace-separated integer token ids — the LM is id-based; no
+    tokenizer ships with the framework)."""
+    if args.prompt_file and args.prompt_file != "-":
+        with open(args.prompt_file) as f:
+            lines = f.readlines()
+    elif args.prompt_file is None and sys.stdin.isatty():
+        return []  # interactive terminal, nothing piped
+    else:
+        lines = sys.stdin.readlines()
+    prompts = []
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            ids = [int(tok) for tok in line.split()]
+        except ValueError:
+            raise SystemExit(
+                f"prompt line {i + 1} is not whitespace-separated token ids: "
+                f"{line[:60]!r}"
+            )
+        if ids:
+            prompts.append((f"line{i + 1}", ids))
+    return prompts
+
+
+def _cmd_serve(args) -> int:
+    """``ddlt serve`` — the serving column's CLI entry point.
+
+    Builds the KV-cached engine (``serve.engine``) over a
+    ``pipelined_transformer`` LM — randomly initialized at the ``--num-
+    layers/--d-model/...`` dims, or restored from ``--checkpoint-dir`` —
+    and drives the continuous-batching scheduler over the prompt source.
+    Completions go to stdout as ``uid<TAB>token ids``; the stats JSON goes
+    to stdout for ``--synthetic`` (the SERVE artifact line) or stderr
+    otherwise, and to ``--report`` when given.
+    """
+    import json as _json
+
+    if args.synthetic:
+        prompts = None
+    else:
+        prompts = _read_prompts(args)
+        if not prompts:
+            print("no prompts (use --synthetic, --prompt-file or stdin)",
+                  file=sys.stderr)
+            return 1
+
+    if args.dry_run:
+        n = args.requests if args.synthetic else len(prompts)
+        print(
+            f"[dry-run] serve {n} request(s), {args.batch_slots} slots, "
+            f"max_new_tokens={args.max_new_tokens}"
+        )
+        return 0
+
+    import jax
+    import numpy as np
+
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        init_params,
+    )
+    from distributeddeeplearning_tpu.serve import (
+        ContinuousBatchingScheduler,
+        Request,
+        data_parallel_engine,
+        synthetic_requests,
+    )
+
+    if args.top_k is not None and args.top_k < 1:
+        print("--top-k must be >= 1", file=sys.stderr)
+        return 1
+    if args.synthetic and args.requests < 1:
+        print("--requests must be >= 1", file=sys.stderr)
+        return 1
+
+    # Checkpoint FIRST: synthetic prompts and validation must see the
+    # restored model's real vocab/position table, not the dim flags.
+    params = None
+    if args.checkpoint_dir:
+        if args.num_heads is None:
+            # a wrong-but-dividing default would reshape K/V into the
+            # wrong head grouping and generate garbage with no error
+            print(
+                "--checkpoint-dir requires an explicit --num-heads "
+                "matching the training config (not derivable from the "
+                "saved qkv shapes)", file=sys.stderr,
+            )
+            return 1
+        from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(args.checkpoint_dir)
+        try:
+            params, step = ckpt.restore_params()
+        finally:
+            ckpt.close()
+        if params is None:
+            print(f"no checkpoint under {args.checkpoint_dir}",
+                  file=sys.stderr)
+            return 1
+        print(f"[serve] restored params at step {step}", file=sys.stderr)
+    num_heads = args.num_heads if args.num_heads is not None else 4
+    vocab = params["head"].shape[1] if params is not None else args.vocab_size
+
+    if args.synthetic:
+        prompts = [
+            (r.uid, r.prompt)
+            for r in synthetic_requests(
+                args.requests, vocab_size=vocab,
+                max_prompt=args.prompt_len,
+                rng=np.random.default_rng(args.seed),
+            )
+        ]
+    max_prompt = max(len(p) for _, p in prompts)
+    max_seq = args.max_seq or (max_prompt + args.max_new_tokens)
+    if params is not None and params["pos"].shape[0] < max_seq:
+        # say so: 'raise --max-seq' can never beat this cap
+        print(
+            f"[serve] max_seq {max_seq} clamped to the checkpoint's "
+            f"position table {params['pos'].shape[0]}", file=sys.stderr,
+        )
+        max_seq = params["pos"].shape[0]
+    if params is None:
+        params = init_params(
+            jax.random.key(args.seed),
+            num_layers=args.num_layers, d_model=args.d_model,
+            num_heads=num_heads, d_ff=args.d_ff,
+            vocab_size=vocab, max_len=max_seq,
+        )
+
+    # Validate up front: engine.prefill raising mid-run (a too-small
+    # --max-seq or the position-table clamp) would discard every
+    # already-finished completion.
+    too_long = [(uid, len(p)) for uid, p in prompts if len(p) >= max_seq]
+    if too_long:
+        uid, n = too_long[0]
+        print(
+            f"{len(too_long)} prompt(s) leave no room to generate at "
+            f"max_seq={max_seq} (first: {uid}, {n} tokens) — raise "
+            "--max-seq (up to the model's position table) or shorten "
+            "the prompts",
+            file=sys.stderr,
+        )
+        return 1
+    # ... and ids against the ACTUAL model vocab (the restored head, not
+    # the flag): jit's gather clamps out-of-range ids silently, which
+    # would decode a plausible completion from a wrong prompt.
+    bad = [
+        (uid, t) for uid, p in prompts for t in p if not 0 <= t < vocab
+    ]
+    if bad:
+        uid, t = bad[0]
+        print(
+            f"{len(bad)} prompt token id(s) outside the model vocab "
+            f"[0, {vocab}) (first: {uid}, id {t})",
+            file=sys.stderr,
+        )
+        return 1
+
+    n_dev = len(jax.devices())
+    engine, mesh = data_parallel_engine(
+        params,
+        num_heads=num_heads,
+        batch_slots=args.batch_slots,
+        max_seq=max_seq,
+        prefill_attention=args.prefill_attention,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        rng=jax.random.key(args.seed),
+    )
+    scheduler = ContinuousBatchingScheduler(
+        engine, eos_id=args.eos_id, max_new_tokens=args.max_new_tokens
+    )
+    results, report = scheduler.run(
+        [Request(uid=uid, prompt=p) for uid, p in prompts]
+    )
+
+    from distributeddeeplearning_tpu.utils.virtual_pod import is_virtual_pod
+
+    stats = report.to_dict()
+    stats["platform"] = jax.default_backend()
+    stats["virtual_pod"] = is_virtual_pod()
+    stats["mesh_devices"] = n_dev if mesh is not None else 1
+    if args.synthetic:
+        print(_json.dumps(stats))
+    else:
+        for r in results:
+            print(f"{r.uid}\t{' '.join(str(t) for t in r.tokens)}")
+        print(_json.dumps(stats), file=sys.stderr)
+    if args.report:
+        with open(args.report, "w") as f:
+            _json.dump(stats, f, indent=2)
+            f.write("\n")
+        print(f"[serve] report -> {args.report}", file=sys.stderr)
+    return 0
+
+
 def _cmd_tpu(args) -> int:
     import json as _json
 
@@ -840,14 +1112,22 @@ def _cmd_storage(args) -> int:
             cache_path_for,
         )
 
+        if not 0 <= args.shard_index < args.shard_count:
+            print(
+                f"--shard-index {args.shard_index} out of range "
+                f"[0, {args.shard_count})", file=sys.stderr,
+            )
+            return 1
         cache_dir = args.cache_dir or cache_path_for(
-            args.data_dir, is_training, args.image_size
+            args.data_dir, is_training, args.image_size,
+            shard_count=args.shard_count, shard_index=args.shard_index,
         )
         if args.dry_run:
             print(f"[dry-run] build_raw_cache({args.data_dir}) -> {cache_dir}")
             return 0
         manifest = build_raw_cache(
-            args.data_dir, cache_dir, is_training, image_size=args.image_size
+            args.data_dir, cache_dir, is_training, image_size=args.image_size,
+            shard_count=args.shard_count, shard_index=args.shard_index,
         )
         size_b = manifest.get(
             "bytes", manifest["count"] * args.image_size**2 * 3

@@ -79,6 +79,7 @@ def block_apply(
     num_heads: int,
     attention: str = "dense",
     attention_fn=None,
+    return_kv: bool = False,
 ):
     """One pre-LN transformer block; ``p`` leaves are per-layer ([...] no L).
 
@@ -93,6 +94,11 @@ def block_apply(
     ``ops.make_ring_attention(mesh, causal=True)`` or
     ``ops.make_ulysses_attention(mesh, causal=True)`` for the
     sequence-parallel decoder.
+
+    ``return_kv=True`` additionally returns this layer's key/value
+    projections as ``(k, v)`` in ``[b, s, h, hd]`` layout — the prefill
+    pass of the serving engine (``serve.engine``) captures them into the
+    KV cache so decode never recomputes the prompt.
     """
     b, s, d = x.shape
     hd = d // num_heads
@@ -100,6 +106,9 @@ def block_apply(
     h = _layer_norm(x, p["ln1"])
     qkv = h @ p["qkv"]  # [b, s, 3d]
     q, k, v = jnp.split(qkv, 3, axis=-1)
+    kv = None
+    if return_kv:
+        kv = (k.reshape(b, s, num_heads, hd), v.reshape(b, s, num_heads, hd))
     if attention_fn is not None:
         split4 = lambda t: t.reshape(b, s, num_heads, hd)  # noqa: E731
         ctx = attention_fn(
@@ -135,6 +144,8 @@ def block_apply(
 
     h = _layer_norm(x, p["ln2"])
     x = x + jax.nn.gelu(h @ p["w_in"], approximate=False) @ p["w_out"]
+    if return_kv:
+        return x, kv
     return x
 
 
@@ -211,6 +222,120 @@ def forward(
         attention_fn=attention_fn, remat=remat, unroll=unroll,
     )
     return x @ params["head"]
+
+
+def forward_prefill(
+    params,
+    tokens,
+    *,
+    num_heads: int,
+    attention: str = "dense",
+):
+    """Prompt pass for the serving engine: logits AND per-layer K/V.
+
+    Same math as :func:`forward` (the parity test pins it), but the layer
+    scan also emits each layer's key/value projections so the caller can
+    seed a KV cache — the prefill half of the prefill/decode split.
+
+    Returns ``(logits [b, s, vocab], k, v)`` with k/v in the cache layout
+    ``[b, L, s, h, hd]`` (``serve.kv_cache`` slot layout minus the slot
+    padding).  ``attention="flash"`` runs the causal Pallas kernel for the
+    prompt pass — the O(S²)-free long-prompt path.
+    """
+    x = _embed(params, tokens)
+
+    def body(carry, layer_params):
+        h, kv = block_apply(
+            layer_params, carry, num_heads=num_heads, attention=attention,
+            return_kv=True,
+        )
+        return h, kv
+
+    x, (k, v) = jax.lax.scan(body, x, params["blocks"])
+    # scan stacks layer-major [L, b, s, h, hd]; the cache is slot-major
+    return x @ params["head"], jnp.moveaxis(k, 0, 1), jnp.moveaxis(v, 0, 1)
+
+
+def _block_decode(p, x, k_l, v_l, pos, *, num_heads: int):
+    """One block's single-token decode against its cache layer.
+
+    ``x``: [B, d] residual stream for the current token of every slot;
+    ``k_l``/``v_l``: [B, S, h, hd] this layer's cache; ``pos``: [B] the
+    position each slot's current token occupies.  The new token's K/V are
+    scattered into the cache *before* attention (each slot at its own
+    position — slots decode at unequal depths under continuous batching),
+    then attention runs dense against positions ``<= pos``.  Exactly
+    :func:`block_apply`'s math restricted to one query row.
+    """
+    b, d = x.shape
+    s = k_l.shape[1]
+    hd = d // num_heads
+
+    h = _layer_norm(x, p["ln1"])
+    qkv = h @ p["qkv"]  # [b, 3d]
+    q, k_t, v_t = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, num_heads, hd)
+    rows = jnp.arange(b)
+    k_l = k_l.at[rows, pos].set(
+        k_t.reshape(b, num_heads, hd).astype(k_l.dtype)
+    )
+    v_l = v_l.at[rows, pos].set(
+        v_t.reshape(b, num_heads, hd).astype(v_l.dtype)
+    )
+    scores = jnp.einsum("bhd,bshd->bhs", q, k_l) / jnp.sqrt(
+        jnp.asarray(hd, jnp.float32)
+    )  # f32 via the f32 scale, matching block_apply
+    visible = jnp.arange(s)[None, :] <= pos[:, None]  # [b, s]
+    scores = jnp.where(visible[:, None, :], scores, -1e30)
+    attn = jax.nn.softmax(scores, axis=-1).astype(v_l.dtype)
+    ctx = jnp.einsum("bhs,bshd->bhd", attn, v_l).reshape(b, d).astype(x.dtype)
+    x = x + ctx @ p["proj"]
+
+    h = _layer_norm(x, p["ln2"])
+    x = x + jax.nn.gelu(h @ p["w_in"], approximate=False) @ p["w_out"]
+    return x, k_l, v_l
+
+
+def forward_decode(params, token, cache, pos, *, num_heads: int):
+    """Single-token decode step: next-token logits from the KV cache.
+
+    ``token``: [B] int32 — each slot's current token; ``pos``: [B] int32 —
+    the position that token occupies (per-slot: continuous batching runs
+    slots at different depths); ``cache``: ``{"k", "v"}`` each
+    ``[B, L, S, h, hd]`` (:mod:`serve.kv_cache` layout).
+
+    Returns ``(logits [B, vocab], new_cache)`` where ``new_cache`` has the
+    token's K/V written at ``pos`` in every layer.  O(S·d) per token per
+    layer — no S² term, THE reason the serve path exists.  Positions
+    ``> pos`` are masked, so stale K/V from a previous occupant of the slot
+    (or prefill padding) can never leak into attention.
+
+    Jit with the cache donated (``serve.engine`` does) so the [B,L,S,h,hd]
+    buffers update in place instead of doubling HBM per step.
+    """
+    x = params["embed"][token] + params["pos"][pos]  # [B, d]
+
+    def body(carry, xs):
+        p, k_l, v_l = xs
+        carry, k_l, v_l = _block_decode(
+            p, carry, k_l, v_l, pos, num_heads=num_heads
+        )
+        return carry, (k_l, v_l)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body,
+        x,
+        (
+            params["blocks"],
+            jnp.moveaxis(cache["k"], 1, 0),
+            jnp.moveaxis(cache["v"], 1, 0),
+        ),
+    )
+    new_cache = {
+        "k": jnp.moveaxis(k_new, 0, 1),
+        "v": jnp.moveaxis(v_new, 0, 1),
+    }
+    return x @ params["head"], new_cache
 
 
 # Which width dim of each stacked block leaf ZeRO-3 shards (leaf layout

@@ -412,11 +412,42 @@ def _auto_block(s: int, cap: int = 1024) -> int:
     [bq, bk] f32 score tile is 4 MB of the 16 MB scoped VMEM; 2048-wide
     tiles exceed the limit and fail to compile), so auto-selection starts
     there and halves until it divides S — seq 1536 gets 512, not an error.
+
+    Sequence lengths with low power-of-two divisibility land on tiny
+    blocks (1032 → 8, odd → 1) whose (S/b)² grids are pathological;
+    :func:`flash_attention` falls back to the dense path below
+    ``AUTO_BLOCK_FLOOR`` instead of running them.
     """
     b = min(cap, s)
     while s % b:
         b //= 2
     return b
+
+
+# Auto-selected blocks below this run a pathological (S/b)² grid; the
+# wrapper warns and takes the dense path instead.  S itself below the floor
+# is fine (the grid is a single tile), so the effective floor is min(S, 128).
+AUTO_BLOCK_FLOOR = 128
+
+
+def _dense_attention(q, k, v, mask, *, dtype, causal):
+    """Reference dense attention with the kernel's exact semantics (f32
+    softmax, key-padding mask, causal triangle) — the fallback when the
+    auto-selected block is pathologically small, and differentiable by
+    plain XLA autodiff."""
+    b, s, h, d = q.shape
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (
+        1.0 / d ** 0.5
+    )
+    if mask is not None:
+        key_mask = jnp.broadcast_to(mask, (b, 1, 1, s))
+        scores = jnp.where(key_mask, scores, NEG_BIG)
+    if causal:
+        scores = jnp.where(
+            jnp.tril(jnp.ones((s, s), bool))[None, None], scores, NEG_BIG
+        )
+    attn = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", attn, v).astype(dtype)
 
 
 def flash_attention(
@@ -444,8 +475,24 @@ def flash_attention(
     Composes with the key-padding ``mask``.
     """
     b, s, h, d = q.shape
-    block_q = _auto_block(s) if block_q is None else min(block_q, s)
-    block_k = _auto_block(s) if block_k is None else min(block_k, s)
+    auto_q, auto_k = block_q is None, block_k is None
+    block_q = _auto_block(s) if auto_q else min(block_q, s)
+    block_k = _auto_block(s) if auto_k else min(block_k, s)
+    floor = min(s, AUTO_BLOCK_FLOOR)
+    if (auto_q and block_q < floor) or (auto_k and block_k < floor):
+        # Low power-of-two divisibility (1032 → block 8, odd S → 1): the
+        # (S/b)² grid compiles and runs pathologically.  Degrading LOUDLY
+        # to dense beats both silent degradation and the old hard error.
+        import warnings
+
+        warnings.warn(
+            f"flash_attention: seq len {s} auto-selects block "
+            f"({block_q}, {block_k}) below the {AUTO_BLOCK_FLOOR} floor — "
+            "falling back to dense attention (pad the sequence or pass "
+            "explicit block_q/block_k to force the kernel)",
+            stacklevel=2,
+        )
+        return _dense_attention(q, k, v, mask, dtype=dtype, causal=causal)
     if s % block_q or s % block_k:
         raise ValueError(
             f"seq len {s} not divisible by blocks ({block_q}, {block_k})"

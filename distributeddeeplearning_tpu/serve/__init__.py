@@ -1,0 +1,53 @@
+"""Serving subsystem: KV-cached autoregressive inference.
+
+The training stack (models / ops / train / workloads) answers "how fast can
+we learn"; this package answers the ROADMAP's other half — serving heavy
+traffic.  It is a separate column of the system, not a flag on the training
+loop (the Podracer actor/learner decomposition, arxiv 2104.06272):
+
+- :mod:`serve.kv_cache` — a preallocated, slot-indexed KV cache pytree
+  sharded over the training mesh's axes;
+- :mod:`serve.engine` — jitted prefill (the Pallas flash-attention prompt
+  pass) and single-token decode with cache donation, plus greedy /
+  temperature / top-k sampling under the train-step RNG convention;
+- :mod:`serve.scheduler` — continuous batching: a request queue feeding
+  cache slots, mid-flight slot release on EOS/length, and per-request
+  latency (TTFT, per-token) + aggregate throughput accounting.
+
+Entry points: ``ddlt serve`` (CLI) and ``bench.py --serve`` (the
+``SERVE_*.json`` artifact).
+"""
+
+from distributeddeeplearning_tpu.serve.engine import (
+    InferenceEngine,
+    data_parallel_engine,
+    sample_logits,
+)
+from distributeddeeplearning_tpu.serve.kv_cache import (
+    cache_bytes,
+    cache_sharding,
+    init_cache,
+    insert_sequence,
+)
+from distributeddeeplearning_tpu.serve.scheduler import (
+    CompletedRequest,
+    ContinuousBatchingScheduler,
+    Request,
+    ServeReport,
+    synthetic_requests,
+)
+
+__all__ = [
+    "InferenceEngine",
+    "data_parallel_engine",
+    "sample_logits",
+    "synthetic_requests",
+    "init_cache",
+    "insert_sequence",
+    "cache_sharding",
+    "cache_bytes",
+    "Request",
+    "CompletedRequest",
+    "ContinuousBatchingScheduler",
+    "ServeReport",
+]

@@ -1,0 +1,308 @@
+"""Jitted prefill/decode engine over the stacked-transformer LM.
+
+The prefill/decode split that TPU serving economics hinge on (arxiv
+2605.25645): prompts run ONCE through the full parallel forward — the
+Pallas flash-attention kernel path, compute-bound, O(P²) FLOPs but O(P)
+memory — and every generated token runs a single-token decode step that is
+pure cache traffic: O(S·d) per layer, bandwidth-bound, no S² anywhere.
+
+Three compiled programs:
+
+- ``prefill``: ``forward_prefill`` on a [1, P] padded prompt bucket
+  (power-of-two buckets bound recompiles), returning the last real
+  position's logits plus the per-layer K/V;
+- ``insert``: one ``dynamic_update_slice`` of those K/V into a cache slot
+  (slot index traced — one executable serves every slot), cache donated;
+- ``decode``: ``forward_decode`` over ALL slots at their own positions +
+  sampling, cache donated so the [slots, L, S, h, hd] buffers update in
+  place.
+
+Sampling follows ``train/step.py``'s RNG convention: one base key, the
+step counter folded in per call (``jax.random.fold_in``), so a serve run
+is exactly reproducible from (seed, request order) alone.
+
+With a ``mesh`` the cache shards slots over the data axes and heads over
+``tensor`` (``kv_cache.cache_sharding``); params replicate.  Decode then
+runs each slot's attention on the chip that owns it — the data-parallel
+serving layout.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributeddeeplearning_tpu.models.pipelined_transformer import (
+    forward_decode,
+    forward_prefill,
+)
+from distributeddeeplearning_tpu.serve.kv_cache import (
+    cache_bytes,
+    cache_sharding,
+    init_cache,
+    insert_sequence,
+)
+
+logger = logging.getLogger("ddlt.serve.engine")
+
+NEG_BIG = -1e30
+
+
+def sample_logits(
+    logits: jax.Array,
+    rng: jax.Array,
+    *,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+) -> jax.Array:
+    """Greedy / temperature / top-k sampling over [..., vocab] logits.
+
+    ``temperature <= 0`` is greedy argmax (rng unused — a greedy run is
+    bitwise deterministic); otherwise logits outside the top ``top_k``
+    (when set) are masked before a temperature-scaled categorical draw.
+    """
+    if top_k is not None and top_k < 1:
+        # top_k=0 would otherwise surface as an opaque broadcast error
+        # deep inside the jitted prefill
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    logits = logits.astype(jnp.float32)
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, NEG_BIG, logits)
+    return jax.random.categorical(rng, logits / temperature, axis=-1).astype(
+        jnp.int32
+    )
+
+
+def prompt_bucket(n: int, max_seq: int, floor: int = 8) -> int:
+    """Smallest power-of-two >= n (>= floor), capped at max_seq — the
+    prefill compile bucket for a prompt of ``n`` tokens.  Public so
+    drivers (``bench.py --serve`` warmup) can enumerate the buckets a
+    request set will compile."""
+    b = floor
+    while b < n:
+        b *= 2
+    return min(b, max_seq)
+
+
+def data_parallel_engine(params, *, num_heads: int, batch_slots: int,
+                         max_seq: int, **engine_kw):
+    """Engine over all visible devices when the slot count allows it.
+
+    The ONE mesh-gating rule both serving entry points (``ddlt serve``,
+    ``bench.py --serve``) share: a pure-DP mesh when ``batch_slots``
+    divides over the device count (``MeshSpec()``'s data axis absorbs
+    everything, so data×fsdp == device count), single-device otherwise.
+    Returns ``(engine, mesh)`` — ``mesh`` is None in the single case.
+    """
+    n_dev = len(jax.devices())
+    mesh = None
+    if n_dev > 1 and batch_slots % n_dev == 0:
+        from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh
+
+        mesh = create_mesh(MeshSpec())
+        logger.info("serve: cache slots sharded over %d devices", n_dev)
+    engine = InferenceEngine(
+        params, num_heads=num_heads, batch_slots=batch_slots,
+        max_seq=max_seq, mesh=mesh, **engine_kw,
+    )
+    return engine, mesh
+
+
+class InferenceEngine:
+    """KV-cached generation over a ``pipelined_transformer`` param pytree.
+
+    The engine owns the device state (params + cache) and exposes exactly
+    the two verbs the continuous-batching scheduler needs:
+
+    - ``prefill(slot, prompt) -> first sampled token`` — run the prompt,
+      seed the slot's cache lines;
+    - ``decode(tokens, pos) -> next tokens`` — one step for ALL slots
+      (the scheduler masks the inactive ones).
+
+    ``prefill_attention="flash"`` (default) runs the prompt pass through
+    the Pallas kernel; tiny prompts fall back to dense inside
+    ``ops.flash_attention`` (the auto-block floor).  Decode is always
+    dense against the cache — there is no S² term to flash away.
+    """
+
+    def __init__(
+        self,
+        params,
+        *,
+        num_heads: int,
+        batch_slots: int,
+        max_seq: int,
+        mesh=None,
+        prefill_attention: str = "flash",
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        cache_dtype=None,
+        rng: Optional[jax.Array] = None,
+        pad_id: int = 0,
+    ):
+        pos_table = params["pos"].shape[0]
+        if max_seq > pos_table:
+            raise ValueError(
+                f"max_seq {max_seq} exceeds the model's position table "
+                f"{pos_table} — re-init the params with max_len >= max_seq"
+            )
+        d_model = params["embed"].shape[1]
+        if d_model % num_heads:
+            raise ValueError(
+                f"d_model {d_model} not divisible by heads {num_heads}"
+            )
+        if top_k is not None and top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        self.params = params
+        self.num_heads = num_heads
+        self.batch_slots = batch_slots
+        self.max_seq = max_seq
+        self.mesh = mesh
+        self.pad_id = pad_id
+        self.vocab_size = params["head"].shape[1]
+        num_layers = params["blocks"]["qkv"].shape[0]
+        head_dim = d_model // num_heads
+        if cache_dtype is None:
+            cache_dtype = params["embed"].dtype
+        self._base_rng = jax.random.key(0) if rng is None else rng
+        self._sample_step = 0
+
+        self._cache = init_cache(
+            batch_slots=batch_slots,
+            num_layers=num_layers,
+            max_seq=max_seq,
+            num_heads=num_heads,
+            head_dim=head_dim,
+            dtype=cache_dtype,
+        )
+
+        sharded = mesh is not None and mesh.devices.size > 1
+        if sharded:
+            if batch_slots % int(np.prod(
+                [mesh.shape[a] for a in ("data", "fsdp")]
+            )):
+                raise ValueError(
+                    f"batch_slots {batch_slots} not divisible by the mesh's "
+                    f"data axes {dict(mesh.shape)}"
+                )
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
+
+            c_shard = cache_sharding(mesh)
+            rep = NamedSharding(mesh, P())
+            slot_vec = NamedSharding(mesh, P(DATA_AXES))
+            p_shard = jax.tree_util.tree_map(lambda _: rep, params)
+            self.params = jax.device_put(params, p_shard)
+            self._cache = jax.device_put(self._cache, c_shard)
+            decode_in = (p_shard, c_shard, slot_vec, slot_vec, rep)
+            decode_out = (rep, c_shard)
+            insert_in = (c_shard, rep, rep, rep)
+            jit_kw = dict(in_shardings=decode_in, out_shardings=decode_out)
+            insert_kw = dict(in_shardings=insert_in, out_shardings=c_shard)
+        else:
+            jit_kw = {}
+            insert_kw = {}
+
+        temperature = float(temperature)
+        base_rng = self._base_rng
+
+        def _sample(logits, step):
+            return sample_logits(
+                logits,
+                jax.random.fold_in(base_rng, step),
+                temperature=temperature,
+                top_k=top_k,
+            )
+
+        def _prefill_fn(params, tokens, length):
+            logits, k, v = forward_prefill(
+                params, tokens, num_heads=num_heads,
+                attention=prefill_attention,
+            )
+            last = jax.lax.dynamic_index_in_dim(
+                logits, length - 1, axis=1, keepdims=False
+            )  # [1, vocab] — the last REAL position, not the padding
+            return last, k, v
+
+        def _insert_fn(cache, k, v, slot):
+            return insert_sequence(cache, k, v, slot)
+
+        def _decode_fn(params, cache, tokens, pos, step):
+            logits, cache = forward_decode(
+                params, tokens, cache, pos, num_heads=num_heads
+            )
+            return _sample(logits, step), cache
+
+        # one compiled prefill per prompt bucket (jit cache keyed on P)
+        self._prefill_jit = jax.jit(_prefill_fn)
+        self._insert_jit = jax.jit(
+            _insert_fn, donate_argnums=(0,), **insert_kw
+        )
+        self._decode_jit = jax.jit(
+            _decode_fn, donate_argnums=(1,), **jit_kw
+        )
+        self._sample_jit = jax.jit(_sample)
+        logger.info(
+            "engine: %d slots x seq %d, %d layers, cache %.1f MB (%s)%s",
+            batch_slots, max_seq, num_layers,
+            cache_bytes(self._cache) / 1e6, np.dtype(cache_dtype).name,
+            " sharded" if sharded else "",
+        )
+
+    @property
+    def cache(self):
+        return self._cache
+
+    def _next_step(self) -> int:
+        step = self._sample_step
+        self._sample_step += 1
+        return step
+
+    def prefill(self, slot: int, prompt: Sequence[int]) -> int:
+        """Run ``prompt`` through the model, seed ``slot``'s cache lines,
+        and return the first sampled continuation token (its K/V enter the
+        cache on the first decode step, at position ``len(prompt)``)."""
+        length = len(prompt)
+        if not length:
+            raise ValueError("empty prompt")
+        if length >= self.max_seq:
+            raise ValueError(
+                f"prompt length {length} leaves no room to generate "
+                f"(max_seq {self.max_seq})"
+            )
+        if not 0 <= slot < self.batch_slots:
+            raise ValueError(f"slot {slot} out of range [0, {self.batch_slots})")
+        bucket = prompt_bucket(length, self.max_seq)
+        tokens = np.full((1, bucket), self.pad_id, np.int32)
+        tokens[0, :length] = np.asarray(prompt, np.int32)
+        last, k, v = self._prefill_jit(
+            self.params, jnp.asarray(tokens), jnp.int32(length)
+        )
+        self._cache = self._insert_jit(
+            self._cache, k, v, jnp.int32(slot)
+        )
+        tok = self._sample_jit(last, jnp.int32(self._next_step()))
+        return int(np.asarray(tok)[0])
+
+    def decode(self, tokens: np.ndarray, pos: np.ndarray) -> np.ndarray:
+        """One decode step for every slot: ``tokens[i]`` at ``pos[i]`` →
+        the sampled next token per slot.  Inactive slots still compute
+        (fixed batch shape is what makes the step a single executable);
+        the scheduler ignores their outputs and their cache writes stay
+        masked behind the slot's position."""
+        toks, self._cache = self._decode_jit(
+            self.params,
+            self._cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(pos, jnp.int32),
+            jnp.int32(self._next_step()),
+        )
+        return np.asarray(toks)

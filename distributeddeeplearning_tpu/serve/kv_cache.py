@@ -1,0 +1,86 @@
+"""Preallocated, slot-indexed KV cache for autoregressive decoding.
+
+Decode reads the *entire* history every step, so the cache — not the
+parameters — is the serving memory budget: ``2 · slots · L · S · h · hd``
+elements, preallocated once and updated in place (the engine jits every
+touch with the cache donated, so steady-state HBM holds exactly one copy).
+
+Layout: ``k, v: [batch_slots, n_layers, max_seq, n_heads, head_dim]``.
+Slot-major so a slot is one contiguous leading-dim slice — admission is a
+single ``dynamic_update_slice`` and the slot axis shards over the training
+mesh's data axes (``parallel.mesh.DATA_AXES``) exactly like a training
+batch; heads shard over ``tensor``.  Layer-major views for the
+scan-over-layers decode are taken with ``moveaxis`` inside the jitted step
+(``models.pipelined_transformer.forward_decode``).
+
+Sequence *lengths* are deliberately not device state: the continuous-
+batching scheduler owns per-slot positions host-side and passes them into
+each decode step as a ``[slots]`` vector, so slot admission/release never
+mutates device buffers beyond the K/V writes themselves.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
+
+Cache = Dict[str, jax.Array]
+
+
+def init_cache(
+    *,
+    batch_slots: int,
+    num_layers: int,
+    max_seq: int,
+    num_heads: int,
+    head_dim: int,
+    dtype: Any = jnp.float32,
+) -> Cache:
+    """Zero-filled cache pytree ``{"k", "v"}``, each [slots, L, S, h, hd].
+
+    Zeros are never *read*: the decode position mask hides every position
+    above a slot's current length, and admission overwrites from 0.
+    """
+    shape = (batch_slots, num_layers, max_seq, num_heads, head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def cache_sharding(mesh) -> Cache:
+    """NamedShardings for the cache: slots over the data axes, heads over
+    ``tensor`` — the serving analogue of the training batch/TP layout, so
+    an engine built on the training mesh reuses its geometry unchanged."""
+    spec = P(DATA_AXES, None, None, "tensor", None)
+    s = NamedSharding(mesh, spec)
+    return {"k": s, "v": s}
+
+
+def insert_sequence(cache: Cache, k: jax.Array, v: jax.Array, slot) -> Cache:
+    """Write one prefilled prompt's K/V into ``slot``, positions [0, P).
+
+    ``k``/``v``: [1, L, P, h, hd] (or [L, P, h, hd]) from
+    ``forward_prefill``; P may be the padded prompt bucket — padding K/V
+    land above the slot's length and stay masked until overwritten by
+    decode steps.  ``slot`` may be a traced index (one compiled insert
+    serves every slot).
+    """
+    if k.ndim == 4:
+        k, v = k[None], v[None]
+    start = (slot, 0, 0, 0, 0)
+    return {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], k.astype(cache["k"].dtype), start
+        ),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], v.astype(cache["v"].dtype), start
+        ),
+    }
+
+
+def cache_bytes(cache: Cache) -> int:
+    """Total cache footprint in bytes (the serving HBM budget line)."""
+    return sum(leaf.size * leaf.dtype.itemsize for leaf in cache.values())

@@ -1,0 +1,256 @@
+"""Continuous batching: a request queue feeding KV-cache slots.
+
+Static batching decodes until the SLOWEST sequence in the batch finishes —
+at heavy traffic the chip idles on finished slots.  Continuous batching
+(Orca-style) releases a slot the moment its sequence hits EOS or its token
+budget, and admits the next queued prompt into the freed slot between
+decode steps, WITHOUT stalling the other slots: the decode executable has
+a fixed [slots] shape, so admission/release is pure host bookkeeping plus
+one prefill+insert for the newcomer.
+
+The scheduler is deliberately host-side and synchronous — one decode step
+per loop iteration, admission between steps.  What it records is the whole
+point of serving benchmarks:
+
+- per-request TTFT (arrival → first token, queue wait included — the
+  number a user feels),
+- per-decode-step latency (≈ inter-token latency at full occupancy),
+- aggregate generated tokens/s and mean slot occupancy (how close the
+  engine runs to its throughput ceiling).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from distributeddeeplearning_tpu.serve.engine import InferenceEngine
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request: a token-id prompt plus an optional
+    per-request token budget (falls back to the scheduler default)."""
+
+    uid: str
+    prompt: Sequence[int]
+    max_new_tokens: Optional[int] = None
+
+
+@dataclasses.dataclass
+class CompletedRequest:
+    uid: str
+    prompt_len: int
+    tokens: List[int]
+    finish_reason: str  # "eos" | "length"
+    ttft_s: float
+    total_s: float
+
+
+@dataclasses.dataclass
+class _SlotState:
+    req: Request
+    budget: int
+    generated: List[int]
+    next_pos: int  # position the NEXT decode input token occupies
+    ttft_s: float
+
+
+@dataclasses.dataclass
+class ServeReport:
+    """Aggregate serving stats — the SERVE_*.json artifact body."""
+
+    requests: int
+    batch_slots: int
+    generated_tokens: int
+    prompt_tokens: int
+    decode_steps: int
+    wall_s: float
+    tokens_per_sec: float
+    ttft_s: Dict[str, float]
+    decode_step_s: Dict[str, float]
+    slot_occupancy_mean: float
+    finish_reasons: Dict[str, int]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def synthetic_requests(
+    n: int,
+    *,
+    vocab_size: int,
+    max_prompt: int,
+    min_prompt: int = 2,
+    rng: Optional[np.random.Generator] = None,
+) -> List[Request]:
+    """``n`` random-token requests with lengths in [min_prompt, max_prompt]
+    — the shared prompt source of ``ddlt serve --synthetic`` and
+    ``bench.py --serve`` (one definition, so the two artifacts measure the
+    same workload shape)."""
+    if n < 1:
+        raise ValueError(f"need at least 1 request, got {n}")
+    rng = np.random.default_rng(0) if rng is None else rng
+    hi = max(min_prompt, max_prompt)
+    return [
+        Request(
+            uid=f"req{i}",
+            prompt=rng.integers(
+                1, vocab_size, rng.integers(min_prompt, hi + 1)
+            ).tolist(),
+        )
+        for i in range(n)
+    ]
+
+
+def _percentiles(xs: Sequence[float]) -> Dict[str, float]:
+    if not xs:
+        return {"p50": 0.0, "p99": 0.0, "mean": 0.0, "max": 0.0}
+    a = np.asarray(xs, np.float64)
+    return {
+        "p50": round(float(np.percentile(a, 50)), 6),
+        "p99": round(float(np.percentile(a, 99)), 6),
+        "mean": round(float(a.mean()), 6),
+        "max": round(float(a.max()), 6),
+    }
+
+
+class ContinuousBatchingScheduler:
+    """Drive an :class:`InferenceEngine` over a stream of requests."""
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        *,
+        eos_id: Optional[int] = None,
+        max_new_tokens: int = 32,
+    ):
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.engine = engine
+        self.eos_id = eos_id
+        self.max_new_tokens = max_new_tokens
+
+    def _finished(self, st: _SlotState) -> Optional[str]:
+        if self.eos_id is not None and st.generated[-1] == self.eos_id:
+            return "eos"
+        if len(st.generated) >= st.budget:
+            return "length"
+        if st.next_pos >= self.engine.max_seq:
+            return "length"  # cache full — no position left to write
+        return None
+
+    def run(
+        self, requests: Iterable[Request]
+    ) -> tuple[List[CompletedRequest], ServeReport]:
+        """Serve every request to completion; returns (results, report).
+
+        Results preserve completion order (not submission order) — the
+        continuous-batching signature: short requests admitted late can
+        finish before long ones admitted early.
+        """
+        engine = self.engine
+        slots = engine.batch_slots
+        pending = deque(requests)
+        for r in pending:
+            # explicit None-check: a falsy 0 must not silently inherit the
+            # scheduler default (it is rejected, matching the class's own
+            # max_new_tokens validation)
+            if r.max_new_tokens is not None and r.max_new_tokens < 1:
+                raise ValueError(
+                    f"request {r.uid}: max_new_tokens must be >= 1, "
+                    f"got {r.max_new_tokens}"
+                )
+        n_requests = len(pending)
+        t_start = time.perf_counter()
+
+        active: Dict[int, _SlotState] = {}
+        free = list(range(slots))
+        tokens_buf = np.zeros(slots, np.int32)
+        pos_buf = np.zeros(slots, np.int32)
+        results: List[CompletedRequest] = []
+        step_times: List[float] = []
+        occupancy: List[float] = []
+        prompt_tokens = 0
+        finish_reasons: Dict[str, int] = {}
+
+        def complete(slot: int, st: _SlotState, reason: str) -> None:
+            now = time.perf_counter()
+            results.append(
+                CompletedRequest(
+                    uid=st.req.uid,
+                    prompt_len=len(st.req.prompt),
+                    tokens=list(st.generated),
+                    finish_reason=reason,
+                    ttft_s=st.ttft_s,
+                    total_s=round(now - t_start, 6),
+                )
+            )
+            finish_reasons[reason] = finish_reasons.get(reason, 0) + 1
+            del active[slot]
+            free.append(slot)
+
+        while pending or active:
+            # Admit prompts into free slots — mid-flight: slots released in
+            # the previous iteration take new work while the rest decode on.
+            while pending and free:
+                req = pending.popleft()
+                slot = free.pop()
+                prompt_tokens += len(req.prompt)
+                first = engine.prefill(slot, req.prompt)
+                st = _SlotState(
+                    req=req,
+                    budget=(
+                        req.max_new_tokens
+                        if req.max_new_tokens is not None
+                        else self.max_new_tokens
+                    ),
+                    generated=[first],
+                    next_pos=len(req.prompt),
+                    ttft_s=round(time.perf_counter() - t_start, 6),
+                )
+                active[slot] = st
+                reason = self._finished(st)
+                if reason is not None:  # EOS straight out of prefill
+                    complete(slot, st, reason)
+
+            if not active:
+                continue
+
+            for slot, st in active.items():
+                tokens_buf[slot] = st.generated[-1]
+                pos_buf[slot] = st.next_pos
+            occupancy.append(len(active) / slots)
+            t0 = time.perf_counter()
+            out = engine.decode(tokens_buf, pos_buf)
+            step_times.append(time.perf_counter() - t0)
+
+            for slot, st in list(active.items()):
+                st.generated.append(int(out[slot]))
+                st.next_pos += 1
+                reason = self._finished(st)
+                if reason is not None:
+                    complete(slot, st, reason)
+
+        wall = time.perf_counter() - t_start
+        generated = sum(len(r.tokens) for r in results)
+        report = ServeReport(
+            requests=n_requests,
+            batch_slots=slots,
+            generated_tokens=generated,
+            prompt_tokens=prompt_tokens,
+            decode_steps=len(step_times),
+            wall_s=round(wall, 4),
+            tokens_per_sec=round(generated / wall, 2) if wall > 0 else 0.0,
+            ttft_s=_percentiles([r.ttft_s for r in results]),
+            decode_step_s=_percentiles(step_times),
+            slot_occupancy_mean=(
+                round(float(np.mean(occupancy)), 4) if occupancy else 0.0
+            ),
+            finish_reasons=finish_reasons,
+        )
+        return results, report

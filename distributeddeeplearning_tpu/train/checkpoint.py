@@ -107,6 +107,38 @@ class Checkpointer:
         logger.info("restored checkpoint step %d from %s", step, self.directory)
         return state, step
 
+    def restore_params(self):
+        """Restore only the latest checkpoint's ``params`` subtree.
+
+        The serving path (``ddlt serve``) needs the weights but neither the
+        optimizer state nor a TrainState template — and must not have to
+        reconstruct the training-time optimizer just to satisfy
+        :meth:`restore`'s template.  Arrays come back host-resident (no
+        target shardings); the engine places them onto its own mesh.
+
+        Cost note: the whole saved tree is read and the non-params subtrees
+        dropped — for an AdamW checkpoint ~3x the bytes actually needed.
+        A params-only partial restore needs ``ocp.PLACEHOLDER``, which this
+        orbax version does not expose; startup-only cost, revisit when the
+        pin moves.
+
+        Returns ``(params, step)``; ``(None, None)`` when no checkpoint.
+        """
+        step = self.latest_step()
+        if step is None:
+            return None, None
+        # StandardRestore with no template restores as-saved; a bare
+        # restore() would need a handler registry in a FRESH process (the
+        # serve flow — the saving process's manager has one implicitly).
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore()
+        )
+        logger.info(
+            "restored params of checkpoint step %d from %s",
+            step, self.directory,
+        )
+        return restored["params"], step
+
     def wait(self) -> None:
         self._mgr.wait_until_finished()
 

@@ -22,8 +22,12 @@ from __future__ import annotations
 import glob
 import gzip
 import json
+import logging
 import os
+import re
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("ddlt.roofline")
 
 # arg-key spellings seen across xprof versions
 _BYTES_KEYS = ("bytes accessed", "bytes_accessed", "raw_bytes_accessed")
@@ -56,11 +60,20 @@ def device_op_events(trace_file: str) -> List[Dict[str, Any]]:
 
     Each event carries its trace ``pid`` (the device/lane it ran on) so
     multi-chip traces can be disaggregated per device — summing across
-    lanes would inflate device time by ~n_devices.
+    lanes would inflate device time by ~n_devices.  When the trace's
+    ``process_name`` metadata names the pid (xprof emits e.g.
+    ``"/device:TPU:0 stream#1"``), the event also carries ``pid_name`` so
+    the analyzer can regroup pids that are really lanes of ONE device.
     """
     opener = gzip.open if trace_file.endswith(".gz") else open
     with opener(trace_file, "rt") as f:
         trace = json.load(f)
+    pid_names: Dict[Any, str] = {}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            name = (ev.get("args") or {}).get("name")
+            if name:
+                pid_names[ev.get("pid", 0)] = str(name)
     out = []
     for ev in trace.get("traceEvents", []):
         if ev.get("ph") != "X" or not ev.get("dur"):
@@ -74,6 +87,7 @@ def device_op_events(trace_file: str) -> List[Dict[str, Any]]:
             if args.get(key):
                 category = str(args[key])
                 break
+        pid = ev.get("pid", 0)
         out.append(
             {
                 "name": ev.get("name", "?"),
@@ -81,10 +95,25 @@ def device_op_events(trace_file: str) -> List[Dict[str, Any]]:
                 "bytes": nbytes,
                 "flops": _arg(args, _FLOPS_KEYS) or 0.0,
                 "category": category or "uncategorized",
-                "pid": ev.get("pid", 0),
+                "pid": pid,
+                "pid_name": pid_names.get(pid),
             }
         )
     return out
+
+
+# strip per-stream/lane suffixes so "/device:TPU:0 stream#1" and
+# "... stream#2" group under one device key
+_STREAM_SUFFIX = re.compile(r"[\s/]*(stream|lane|thread)[\s:#]*\d+\s*$", re.I)
+
+
+def _lane_key(event: Dict[str, Any]):
+    name = event.get("pid_name")
+    if name:
+        base = _STREAM_SUFFIX.sub("", name).strip()
+        if base:
+            return base
+    return event["pid"]
 
 
 def analyze_trace(
@@ -111,14 +140,43 @@ def analyze_trace(
 
     # A multi-chip trace has one lane (pid) per device; the per-device
     # roofline comes from ONE lane — summing all lanes would multiply
-    # device time and bytes by ~n_devices.  Analyze the busiest lane (on a
-    # single-chip trace that is simply the only lane).
+    # device time and bytes by ~n_devices.  Some backends instead split
+    # ONE device's events across several pids (streams); pids are first
+    # regrouped by device name from the trace metadata so those merge back
+    # into one lane.  Then analyze the busiest lane (on a single-chip
+    # trace that is simply the only lane).
+    n_pids = len({e["pid"] for e in events})
     lane_us: Dict[Any, float] = {}
     for e in events:
-        lane_us[e["pid"]] = lane_us.get(e["pid"], 0.0) + e["dur_us"]
+        key = _lane_key(e)
+        lane_us[key] = lane_us.get(key, 0.0) + e["dur_us"]
     n_lanes = len(lane_us)
+    if n_lanes < n_pids:
+        logger.info(
+            "roofline: merged %d trace pids into %d device lanes via "
+            "process_name metadata", n_pids, n_lanes,
+        )
     busiest = max(lane_us, key=lane_us.get)
-    events = [e for e in events if e["pid"] == busiest]
+    all_lanes_us = sum(lane_us.values())
+    busiest_share = lane_us[busiest] / max(all_lanes_us, 1e-9)
+    # Busiest-lane sanity check: the heuristic assumes the winner holds one
+    # device's COMPLETE step stream.  When it holds barely more than an
+    # even 1/n split of total device time, the pids may be streams of one
+    # device that metadata could not regroup — per-step time and bytes
+    # would then be under-reported by ~n_lanes.  (A multi-chip trace with
+    # even per-device load also lands here; that case is benign, which is
+    # why this warns rather than raises.)
+    lane_warning = None
+    if n_lanes > 1 and busiest_share < 1.25 / n_lanes:
+        lane_warning = (
+            f"busiest lane holds {busiest_share:.1%} of device time across "
+            f"{n_lanes} lanes (~an even split): if this trace is from ONE "
+            "device whose events span multiple pids, per-step time/bytes "
+            "are under-reported by ~n_lanes; for a multi-chip trace with "
+            "even load this is expected"
+        )
+        logger.warning("roofline: %s", lane_warning)
+    events = [e for e in events if _lane_key(e) == busiest]
 
     total_us = sum(e["dur_us"] for e in events)
     total_bytes = sum(e["bytes"] for e in events)
@@ -178,6 +236,8 @@ def analyze_trace(
     result: Dict[str, Any] = {
         "steps_analyzed": steps,
         "device_lanes_in_trace": n_lanes,
+        "busiest_lane_share": round(busiest_share, 4),
+        "lane_warning": lane_warning,
         "device_ms_per_step": round(measured_ms, 2),
         "hbm_gb_per_step": round(bytes_per_step / 1e9, 2),
         "model_gflops_per_step": round(total_flops / steps / 1e9, 1),

@@ -26,6 +26,18 @@ def is_reexec_child() -> bool:
     return os.environ.get(SENTINEL) == "1"
 
 
+def is_virtual_pod() -> bool:
+    """True when this run's devices are faked CPUs — the re-exec sentinel
+    or an ``xla_force_host_platform_device_count`` hint in XLA_FLAGS.  The
+    ONE definition every artifact-emitting entry point (bench.py, ``ddlt
+    serve``) records, so CPU numbers can never masquerade as hardware in
+    one artifact while being flagged in another."""
+    return is_reexec_child() or (
+        "xla_force_host_platform_device_count"
+        in os.environ.get("XLA_FLAGS", "")
+    )
+
+
 def force_cpu_platform_if_virtual_pod() -> None:
     """Pin the CPU platform before backend init when a virtual pod was
     requested — by the re-exec sentinel OR by an

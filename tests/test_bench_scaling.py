@@ -72,3 +72,22 @@ def test_collective_stats_parses_hlo():
     assert stats["all-gather"] == {"count": 1, "bytes": 2 * 64 * 2}
     assert stats["collective-permute"] == {"count": 1, "bytes": 8 * 8 * 4}
     assert "all-to-all" not in stats
+
+
+def test_collective_stats_async_start_result_half():
+    """Async -start tuples count the RESULT half only: an all-gather-start
+    whose operand and result differ by the axis-size factor reports the
+    gathered (output-shape) bytes, not 75% of them; equal-size tuples
+    (all-reduce) are unchanged, and odd tuples fall back to halving."""
+    text = """
+  %ag-start = (f32[64]{0}, f32[128]{0}) all-gather-start(%p0), dimensions={0}
+  %ag-done = f32[128]{0} all-gather-done(%ag-start)
+  %rs-start = (bf16[4,64]{1,0}, bf16[2,64]{1,0}) reduce-scatter-start(%p1)
+  %ar-start = (f32[32]{0}, f32[32]{0}, u32[], u32[]) all-reduce-start(%p2)
+"""
+    stats = bench._collective_stats(text)
+    assert stats["all-gather"] == {"count": 1, "bytes": 128 * 4}
+    assert stats["reduce-scatter"] == {"count": 1, "bytes": 2 * 64 * 2}
+    # u32[] context scalars are bookkeeping, not traffic: the (operand,
+    # result, u32[], u32[]) tuple still reports the moved tensor once
+    assert stats["all-reduce"] == {"count": 1, "bytes": 32 * 4}

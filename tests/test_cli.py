@@ -382,3 +382,34 @@ def test_storage_build_cache_verb(tmp_path):
     import os
 
     assert not os.path.exists(tmp_path / "nope")
+
+
+def test_storage_build_cache_shard_flags(tmp_path):
+    """--shard-count/--shard-index pre-build the per-host '-shardIofN'
+    cache dirs multi-host runs actually read (unsuffixed caches were
+    silently ignored by sharded jobs)."""
+    from distributeddeeplearning_tpu.data.bench_data import (
+        generate_bench_shards,
+    )
+    from distributeddeeplearning_tpu.data.raw_cache import (
+        cache_path_for,
+        open_raw_cache,
+    )
+
+    d = str(tmp_path / "shards")
+    generate_bench_shards(d, num_images=8, num_shards=2, seed=4)
+    assert main([
+        "storage", "build-cache", "--data-dir", d, "--split", "train",
+        "--image-size", "32", "--shard-count", "2", "--shard-index", "1",
+    ]) == 0
+    expected = cache_path_for(d, True, 32, shard_count=2, shard_index=1)
+    assert expected.endswith("-shard1of2")
+    manifest, images, labels = open_raw_cache(expected)
+    assert manifest["count"] > 0
+    assert images.shape[1:] == (32, 32, 3)
+
+    # out-of-range index is rejected loudly
+    assert main([
+        "storage", "build-cache", "--data-dir", d,
+        "--shard-count", "2", "--shard-index", "2",
+    ]) == 1

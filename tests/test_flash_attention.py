@@ -343,3 +343,60 @@ def test_auto_block_nondivisible_seq():
     out = flash_attention(q, k, v, None, dtype=jnp.float32, causal=True)
     assert out.shape == (1, 1536, 1, 8)
     assert bool(jnp.isfinite(out).all())
+
+
+def test_auto_block_floor_falls_back_to_dense():
+    """Low-divisibility seq lens (1032 -> block 8, odd -> 1) must not run
+    a pathological (S/b)^2 grid: the wrapper warns and takes the dense
+    path, matching a plain-XLA reference exactly."""
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearning_tpu.ops.flash_attention import (
+        _auto_block,
+        flash_attention,
+    )
+
+    assert _auto_block(1032) == 8  # the pathological selection itself
+
+    rng = np.random.default_rng(1)
+    s = 1032
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, s, 1, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    with pytest.warns(UserWarning, match="below the 128 floor"):
+        out = flash_attention(q, k, v, None, dtype=jnp.float32, causal=True)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(8.0)
+    scores = jnp.where(jnp.tril(jnp.ones((s, s), bool)), scores, -1e30)
+    ref = jnp.einsum(
+        "bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1), v
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    # the fallback is differentiable (custom_vjp no longer in the path)
+    g = jax.grad(
+        lambda q: flash_attention(
+            q, k, v, None, dtype=jnp.float32, causal=True
+        ).sum()
+    )(q)
+    assert bool(jnp.isfinite(g).all())
+
+    # seqs at/below the floor keep the kernel: single-tile grids are fine
+    q2, k2, v2 = (x[:, :64] for x in (q, k, v))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out2 = flash_attention(q2, k2, v2, None, dtype=jnp.float32,
+                               causal=True)
+    assert out2.shape == (1, 64, 1, 8)
+
+    # explicit tiny blocks are honoured (caller opted in) — no warning
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out3 = flash_attention(q2, k2, v2, None, dtype=jnp.float32,
+                               causal=True, block_q=8, block_k=8)
+    np.testing.assert_allclose(np.asarray(out3), np.asarray(out2), atol=2e-5)

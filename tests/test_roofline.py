@@ -113,3 +113,52 @@ def test_empty_trace_raises(tmp_path):
 def test_missing_trace_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         find_trace_file(str(tmp_path))
+
+
+def test_even_lane_split_warns(tmp_path, caplog):
+    """Busiest-pid sanity check: when the winner holds ~an even 1/n share
+    of device time (one device's events possibly split across pids), the
+    analyzer says so instead of silently dropping lanes."""
+    import logging
+
+    events = []
+    for pid in (1, 2):  # one device's step stream split over two pids
+        ev = _dev_event("fusion.1", 100.0, 80_000_000, 0, "fusion")
+        ev["pid"] = pid
+        events.append(ev)
+    _write_trace(str(tmp_path), events)
+    with caplog.at_level(logging.WARNING, logger="ddlt.roofline"):
+        r = analyze_trace(str(tmp_path), steps=1)
+    assert r["device_lanes_in_trace"] == 2
+    assert r["busiest_lane_share"] == pytest.approx(0.5)
+    assert r["lane_warning"] and "even split" in r["lane_warning"]
+    assert any("even split" in m for m in caplog.messages)
+
+
+def test_dominant_lane_does_not_warn(mini_trace):
+    r = analyze_trace(mini_trace, steps=2)
+    assert r["device_lanes_in_trace"] == 1
+    assert r["busiest_lane_share"] == pytest.approx(1.0)
+    assert r["lane_warning"] is None
+
+
+def test_stream_pids_merge_by_device_name(tmp_path):
+    """process_name metadata naming two pids as streams of ONE device
+    regroups them into a single lane — per-step time/bytes become the SUM,
+    not the busiest stream's half."""
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0 stream#1"}},
+        {"ph": "M", "name": "process_name", "pid": 2,
+         "args": {"name": "/device:TPU:0 stream#2"}},
+    ]
+    for pid, dur in ((1, 100.0), (2, 60.0)):
+        ev = _dev_event("fusion.1", dur, 40_000_000, 0, "fusion")
+        ev["pid"] = pid
+        events.append(ev)
+    _write_trace(str(tmp_path), events)
+    r = analyze_trace(str(tmp_path), steps=1)
+    assert r["device_lanes_in_trace"] == 1  # merged
+    assert r["lane_warning"] is None
+    assert r["device_ms_per_step"] == pytest.approx(0.16)  # 100+60 us
+    assert r["hbm_gb_per_step"] == pytest.approx(0.08, abs=0.006)
