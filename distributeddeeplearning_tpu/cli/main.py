@@ -277,6 +277,32 @@ def build_parser() -> argparse.ArgumentParser:
     _add_submit_tree(sub, "benchmark", formats=("synthetic",))
     _add_submit_tree(sub, "experiment", formats=())
 
+    train_p = sub.add_parser(
+        "train",
+        help="Run a workload IN-PROCESS under the restart supervisor "
+        "(train/resilience.py): on preemption, anomaly abort or data-stream "
+        "death the workload is re-entered and resumes from its latest "
+        "checkpoint, up to --max-restarts times.  Unknown --flags pass "
+        "through to the workload main (same contract as the submit verbs).",
+    )
+    train_p.add_argument(
+        "train_workload",
+        metavar="workload",
+        choices=("imagenet", "bert", "transformer", "benchmark", "experiment"),
+        help="workload module to supervise",
+    )
+    train_p.add_argument(
+        "--max-restarts", type=int, default=0,
+        help="in-process restarts after a restartable failure (preemption, "
+        "anomaly abort, data-stream death); pass --save_filepath so the "
+        "restart actually resumes instead of starting over",
+    )
+    train_p.add_argument(
+        "--faults", default=None,
+        help="fault-injection spec (overrides the DDLT_FAULTS env var), "
+        'e.g. "nan_loss@12,preempt@50" — see README "Fault tolerance"',
+    )
+
     serve_p = sub.add_parser(
         "serve",
         help="KV-cached autoregressive inference with continuous batching "
@@ -565,7 +591,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = build_parser()
     args, extra = parser.parse_known_args(argv)
     if extra and args.command not in (
-        "imagenet", "bert", "transformer", "benchmark", "experiment"
+        "imagenet", "bert", "transformer", "benchmark", "experiment", "train"
     ):
         parser.error(f"unrecognized arguments: {' '.join(extra)}")
 
@@ -651,6 +677,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.command == "tpu":
         return _cmd_tpu(args)
+    if args.command == "train":
+        return _cmd_train(args, extra)
     if args.command == "serve":
         return _cmd_serve(args)
     if args.command == "storage":
@@ -819,6 +847,121 @@ def _cmd_setup(args) -> int:
         if storage is not None:
             storage.upload_tfrecords(tfrecords_dir)
     print("setup complete")
+    return 0
+
+
+def _cmd_train(args, extra: List[str]) -> int:
+    """``ddlt train`` — the in-process restart supervisor.
+
+    Runs the workload's ``main`` in THIS process and re-enters it on
+    restartable failures (``train/resilience.py``): a preemption that
+    landed its emergency checkpoint, an anomaly abort, or a data-stream
+    death.  Because the workloads default to ``resume=True``, each restart
+    continues from the latest checkpoint — pass ``--save_filepath`` or the
+    restarts begin from scratch.  Exhausting the budget on a preemption
+    exits ``RESUMABLE_EXIT_CODE`` (75) so an OUTER supervisor (k8s, the
+    control plane's resubmit loop) can take over; other exhausted failures
+    exit 1.
+    """
+    import importlib
+    import os
+
+    from distributeddeeplearning_tpu.control.submit import WORKLOAD_MODULES
+    from distributeddeeplearning_tpu.train import resilience
+    from distributeddeeplearning_tpu.utils import faults
+    from distributeddeeplearning_tpu.utils.faults import DataStreamDeath
+    from distributeddeeplearning_tpu.workloads._runner import (
+        coerce_flags,
+        parse_flags,
+    )
+
+    if args.max_restarts < 0:
+        print("--max-restarts must be >= 0", file=sys.stderr)
+        return 2
+    if args.faults is not None:
+        os.environ[faults.ENV_VAR] = args.faults
+    # Fresh plan per invocation: one-shot faults re-arm for THIS run but
+    # stay fired across its in-process restarts.
+    faults.reset()
+
+    workload = args.train_workload
+    module = importlib.import_module(WORKLOAD_MODULES[workload])
+    kwargs = coerce_flags(module.main, parse_flags(extra))
+    if args.dry_run:
+        flags = " ".join(f"--{k} {v}" for k, v in kwargs.items())
+        print(
+            f"[dry-run] supervise {workload} (max_restarts="
+            f"{args.max_restarts}) {flags}".rstrip()
+        )
+        return 0
+    if args.max_restarts and not kwargs.get("save_filepath"):
+        logger.warning(
+            "--max-restarts without --save_filepath: restarts will begin "
+            "from scratch (no checkpoint to resume from)"
+        )
+
+    def attempt(i: int):
+        if i:
+            print(f"[train] restart {i}/{args.max_restarts}", file=sys.stderr)
+        return module.main(**kwargs)
+
+    def latest_ckpt_step() -> int:
+        from pathlib import Path
+
+        ckpt_dir = kwargs.get("save_filepath")
+        if not ckpt_dir or not Path(ckpt_dir).exists():
+            return 0
+        steps = [
+            int(p.name) for p in Path(ckpt_dir).iterdir() if p.name.isdigit()
+        ]
+        return max(steps, default=0)
+
+    redone = {"steps": 0}
+
+    def on_restart(i: int, exc: BaseException) -> None:
+        # recovery-cost accounting: how many completed steps the restart
+        # re-does (0 when the emergency checkpoint landed at the exact
+        # failure step; >0 when resuming from an older periodic save)
+        at = getattr(exc, "step", None)
+        if at is None:
+            return
+        done = at if isinstance(exc, resilience.PreemptionError) else at - 1
+        redone["steps"] += max(done - latest_ckpt_step(), 0)
+
+    restartable = (resilience.RestartableError, DataStreamDeath, StopIteration)
+    try:
+        result, restarts = resilience.supervise(
+            attempt, max_restarts=args.max_restarts, restart_on=restartable,
+            on_restart=on_restart,
+        )
+    except resilience.PreemptionError as exc:
+        print(
+            f"[train] {exc} — restart budget exhausted; exiting "
+            f"{resilience.RESUMABLE_EXIT_CODE} (resumable)",
+            file=sys.stderr,
+        )
+        return resilience.RESUMABLE_EXIT_CODE
+    except restartable as exc:
+        print(
+            f"[train] {type(exc).__name__}: {exc} — restart budget "
+            "exhausted; giving up",
+            file=sys.stderr,
+        )
+        return 1
+    if (
+        isinstance(result, tuple) and len(result) == 2
+        and hasattr(result[1], "anomalous_steps")
+    ):
+        state, fit = result
+        print(
+            f"[train] {workload} completed at step {int(state.step)}: "
+            f"restarts={restarts} redone_steps={redone['steps']} "
+            f"anomalous_steps={fit.anomalous_steps} "
+            f"rollbacks={fit.rollbacks} "
+            f"images_per_second={fit.images_per_second:.1f}"
+        )
+    else:
+        print(f"[train] {workload} completed: restarts={restarts}")
     return 0
 
 

@@ -16,6 +16,7 @@ import dataclasses
 import logging
 import shlex
 import subprocess
+import time
 from typing import Dict, List, Optional, Sequence
 
 logger = logging.getLogger("ddlt.control")
@@ -50,6 +51,7 @@ class CommandRunner:
     def __init__(self, dry_run: bool = False):
         self.dry_run = dry_run
         self.history: List[List[str]] = []
+        self._sleep = time.sleep  # injectable for tests
 
     def run(
         self,
@@ -60,6 +62,7 @@ class CommandRunner:
         env: Optional[Dict[str, str]] = None,
         timeout: Optional[float] = None,
         stream_to: Optional[str] = None,
+        retries: int = 0,
     ) -> CommandResult:
         """Execute ``argv``.
 
@@ -70,36 +73,74 @@ class CommandRunner:
         epochs instead of printing nothing until exit.  The returned
         ``CommandResult.stdout`` carries the tail of the stream so failure
         paths can still report context.
+
+        ``retries`` re-runs a FAILING command up to that many times with
+        jittered exponential backoff (``utils/retry.py``) before the
+        check/return decision — for idempotent cloud reads (``gcloud
+        describe``, state probes) that fail transiently all the time.
+        Every attempt is recorded in ``history``.  Never retry mutating
+        verbs that are not idempotent.
         """
         argv = [str(a) for a in argv]
-        self.history.append(argv)
         if self.dry_run:
+            self.history.append(argv)
             print(f"[dry-run] {shlex.join(argv)}")
             return CommandResult(argv=argv, returncode=0)
+        # Lazy import: pulling utils.retry at module scope executes the
+        # utils package __init__, which imports jax — and the control plane
+        # must stay importable (and fast) on jax-less operator machines.
+        from distributeddeeplearning_tpu.utils.retry import backoff_delays
+
+        delays = backoff_delays(retries, base_delay=0.5, max_delay=10.0)
+        attempt = 0
+        while True:
+            result = self._run_once(
+                argv, capture=capture, env=env, timeout=timeout,
+                stream_to=stream_to,
+            )
+            if result.ok or attempt >= retries:
+                break
+            delay = next(delays)
+            attempt += 1
+            logger.warning(
+                "command failed (rc=%d): %s — retry %d/%d in %.1fs",
+                result.returncode, shlex.join(argv), attempt, retries, delay,
+            )
+            self._sleep(delay)
+        if check and not result.ok:
+            raise CommandError(argv, result.returncode, result.stdout, result.stderr)
+        return result
+
+    def _run_once(
+        self,
+        argv: List[str],
+        *,
+        capture: bool,
+        env: Optional[Dict[str, str]],
+        timeout: Optional[float],
+        stream_to: Optional[str],
+    ) -> CommandResult:
+        self.history.append(argv)
         logger.debug("exec: %s", shlex.join(argv))
         if stream_to is not None:
             if timeout is not None:
                 # The line-by-line tee loop has no read deadline; silently
                 # dropping a requested bound would be worse than refusing.
                 raise ValueError("timeout is not supported with stream_to")
-            result = self._run_streaming(argv, stream_to, env=env)
-        else:
-            proc = subprocess.run(
-                argv,
-                capture_output=capture,
-                text=True,
-                env=env,
-                timeout=timeout,
-            )
-            result = CommandResult(
-                argv=argv,
-                returncode=proc.returncode,
-                stdout=proc.stdout or "",
-                stderr=proc.stderr or "",
-            )
-        if check and not result.ok:
-            raise CommandError(argv, result.returncode, result.stdout, result.stderr)
-        return result
+            return self._run_streaming(argv, stream_to, env=env)
+        proc = subprocess.run(
+            argv,
+            capture_output=capture,
+            text=True,
+            env=env,
+            timeout=timeout,
+        )
+        return CommandResult(
+            argv=argv,
+            returncode=proc.returncode,
+            stdout=proc.stdout or "",
+            stderr=proc.stderr or "",
+        )
 
     _STREAM_TAIL_CHARS = 8192
 

@@ -91,6 +91,18 @@ class RunRegistry:
             run.finished_at = _dt.datetime.now().isoformat(timespec="seconds")
         self._write(run)
 
+    def append_event(self, run: Run, message: str) -> None:
+        """Append a timestamped lifecycle event to ``run.extra['events']``.
+
+        The preemption/restart audit trail: every recreate, resubmit and
+        resumable-exit restart lands here so ``ddlt runs --run ID`` can
+        answer "what happened to this run" after the fact.
+        """
+        stamp = _dt.datetime.now().isoformat(timespec="seconds")
+        events = run.extra.setdefault("events", [])
+        events.append(f"{stamp} {message}")
+        self._write(run)
+
     def run_dir(self, run: Run) -> Path:
         return self._run_dir(run.experiment, run.run_id)
 

@@ -93,7 +93,11 @@ class GcsStorage:
     # -- transfer (azcopy parity) ---------------------------------------
 
     def _rsync(self, src: str, dst: str):
-        return self.runner.run(["gcloud", "storage", "rsync", "-r", src, dst])
+        # rsync is idempotent, so transient gs:// failures retry safely
+        # (utils/retry.py backoff via CommandRunner).
+        return self.runner.run(
+            ["gcloud", "storage", "rsync", "-r", src, dst], retries=2
+        )
 
     def upload(self, local_dir: str, remote_prefix: str):
         return self._rsync(str(local_dir), f"{self.url}/{remote_prefix}")

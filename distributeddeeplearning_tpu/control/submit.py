@@ -27,6 +27,7 @@ from __future__ import annotations
 import logging
 import os
 import sys
+import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
@@ -35,6 +36,14 @@ from distributeddeeplearning_tpu.control.runs import Run, RunRegistry
 from distributeddeeplearning_tpu.control.tpu import TpuPod, pod_from_settings
 
 logger = logging.getLogger("ddlt.control.submit")
+
+# The workload runner's resumable exit code (train/resilience.py
+# RESUMABLE_EXIT_CODE = 75, EX_TEMPFAIL).  Declared here as a literal
+# rather than imported: importing anything under `train` executes
+# train/__init__, which pulls the full jax/flax/optax stack into every
+# control-plane command on operator machines that only shell out to
+# gcloud.  tests/test_resilience.py pins the two values equal.
+RESUMABLE_EXIT_CODE = 75
 
 DATASTORE_PLACEHOLDER = "{datastore}"
 
@@ -80,6 +89,14 @@ def params_to_flags(params: Dict[str, Any]) -> List[str]:
     return flags
 
 
+# Pod lifecycle states worth waiting out before resubmitting; stable states
+# (READY, PREEMPTED, TERMINATED, absent) return to the caller immediately.
+_TRANSITIONAL_POD_STATES = {
+    "CREATING", "STARTING", "RESTARTING", "REPAIRING", "PROVISIONING",
+    "STOPPING",
+}
+
+
 class Submitter:
     """Composes and executes workload launches, local and remote."""
 
@@ -94,6 +111,30 @@ class Submitter:
         self.registry = registry or RunRegistry(
             settings.get("RUNS_DIR", "runs") or "runs"
         )
+        self._sleep = time.sleep  # injectable for tests
+
+    def _await_pod_ready(
+        self, pod: TpuPod, *, attempts: int = 30, interval_s: float = 10.0
+    ) -> Optional[str]:
+        """Poll pod state through transitional phases; return the first
+        stable state seen (READY, PREEMPTED, TERMINATED, None, ...).
+
+        The preemption retry loop calls this after ``recreate()`` so the
+        resubmit doesn't race a pod that is still CREATING; stable non-READY
+        states return immediately — deciding what to do about them is the
+        caller's policy.
+        """
+        state = pod.state(retries=2)
+        polled = 0
+        while state in _TRANSITIONAL_POD_STATES and polled < attempts:
+            polled += 1
+            logger.info(
+                "pod %s state %s — waiting (%d/%d)",
+                pod.name, state, polled, attempts,
+            )
+            self._sleep(interval_s)
+            state = pod.state(retries=2)
+        return state
 
     # -- composition helpers --------------------------------------------
 
@@ -184,12 +225,21 @@ class Submitter:
 
         ``max_retries`` (default from ``MAX_RETRIES`` setting, 0) adds the
         preemption handling both the reference and plain Horovod lack
-        (SURVEY.md §5 "Failure detection… None in-repo"): when the launch
-        fails and the pod is gone or not READY — the preemptible-TPU
-        signature — the pod is recreated and the identical command resent.
+        (SURVEY.md §5 "Failure detection… None in-repo").  Two recovery
+        paths share the retry budget:
+
+        - **resumable exit** (rc == 75, the workload runner's
+          ``RESUMABLE_EXIT_CODE``): the preemption guard landed an
+          emergency checkpoint and asked to be restarted — the identical
+          command is resent to the SAME pod, no recreate;
+        - **pod loss** (launch failed and the pod is PREEMPTED / gone /
+          otherwise not READY): recreate the pod, poll its state until
+          READY (``_await_pod_ready``), re-bootstrap, resend.
+
         Checkpoints live in the run's GCS dir and the workloads default to
-        ``resume=True``, so a retried run continues from the last epoch
-        rather than restarting.
+        ``resume=True``, so a retried run continues from its last
+        checkpointed step rather than restarting.  Every retry decision is
+        recorded in the run's ``events`` audit trail.
         """
         params = self._resolve_params(params, "remote")
         experiment = experiment or self.settings.get("EXPERIMENT_NAME", "experiment")
@@ -242,18 +292,46 @@ class Submitter:
         )
         attempts = 1
         while not result.ok and attempts <= max_retries:
-            state = pod.state()
+            if result.returncode == RESUMABLE_EXIT_CODE:
+                # The workload's preemption guard checkpointed and exited
+                # resumable: the pod is (still) usable, the run continues
+                # from the emergency checkpoint — resend, don't recreate.
+                logger.warning(
+                    "run %s attempt %d exited resumable (rc=%d) — "
+                    "resubmitting to the same pod (%d/%d)",
+                    run.run_id, attempts, result.returncode,
+                    attempts, max_retries,
+                )
+                self.registry.append_event(
+                    run,
+                    f"attempt {attempts}: resumable exit "
+                    f"(rc={RESUMABLE_EXIT_CODE}); resubmitting",
+                )
+                result = pod.ssh(
+                    command, worker="all", env=env, check=False,
+                    stream_to=log_path,
+                )
+                attempts += 1
+                continue
+            state = pod.state(retries=2)
             if state == "READY":
                 # The pod is healthy: the failure is the workload's, not a
                 # preemption — retrying the same code would fail the same way.
                 logger.error(
                     "run %s failed with pod READY; not retrying", run.run_id
                 )
+                self.registry.append_event(
+                    run, f"attempt {attempts}: failed with pod READY; "
+                    "not retrying"
+                )
                 break
             logger.warning(
                 "run %s attempt %d failed (pod state %s) — recreating pod "
                 "and resubmitting (%d/%d)",
                 run.run_id, attempts, state, attempts, max_retries,
+            )
+            self.registry.append_event(
+                run, f"attempt {attempts}: pod state {state}; recreating"
             )
             ship_dir = project_dir or self.settings.get("PROJECT_DIR", "")
             if not ship_dir or ship_dir == ".":
@@ -267,6 +345,16 @@ class Submitter:
                 break
             try:
                 pod.recreate()
+                ready_state = self._await_pod_ready(pod)
+                if ready_state != "READY":
+                    # Advisory: a queued-resource recreate may still be
+                    # WAITING_FOR_RESOURCES.  Resubmit anyway — the SSH
+                    # failure consumes the bounded retry budget, so this
+                    # cannot loop forever.
+                    logger.warning(
+                        "run %s: recreated pod state is %s (not READY); "
+                        "resubmitting anyway", run.run_id, ready_state,
+                    )
                 # Fresh VMs have nothing installed: re-run the bootstrap
                 # (scp + pip install) or the identical resubmit dies on
                 # import.  PROJECT_DIR names the source tree to ship.
@@ -278,7 +366,14 @@ class Submitter:
                     "run %s: pod recreate/bootstrap failed (%s); giving up",
                     run.run_id, exc,
                 )
+                self.registry.append_event(
+                    run, f"attempt {attempts}: recreate/bootstrap failed "
+                    f"({exc}); giving up"
+                )
                 break
+            self.registry.append_event(
+                run, f"attempt {attempts}: pod recreated; resubmitting"
+            )
             result = pod.ssh(
                 command, worker="all", env=env, check=False, stream_to=log_path
             )

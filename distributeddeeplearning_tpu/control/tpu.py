@@ -83,12 +83,20 @@ class TpuPod:
             argv += ["--project", self.project]
         return argv
 
-    def _describe_json(self, name: str, *, surface: str = "tpu-vm"):
-        """Describe ``name`` on a gcloud surface → dict, or None if absent."""
+    def _describe_json(
+        self, name: str, *, surface: str = "tpu-vm", retries: int = 0
+    ):
+        """Describe ``name`` on a gcloud surface → dict, or None if absent.
+
+        ``retries`` re-probes transient gcloud failures (idempotent read) —
+        the preemption retry loop passes it so one flaky describe does not
+        get mistaken for a vanished pod.
+        """
         result = self.runner.run(
             self._base("describe", name, surface=surface)
             + ["--zone", self.zone, "--format", "json"],
             check=False,
+            retries=retries,
         )
         if self.runner.dry_run:
             # Assume absent so dry-run shows the mutation commands too.
@@ -100,17 +108,17 @@ class TpuPod:
         except json.JSONDecodeError:
             return {}
 
-    def describe(self):
+    def describe(self, *, retries: int = 0):
         """Pod metadata dict, or None when the pod does not exist."""
-        return self._describe_json(self.name)
+        return self._describe_json(self.name, retries=retries)
 
     def exists(self) -> bool:
         return self.describe() is not None
 
-    def state(self) -> Optional[str]:
+    def state(self, *, retries: int = 0) -> Optional[str]:
         """Lifecycle state from the API (READY, PREEMPTED, TERMINATED, …);
         None when the pod does not exist."""
-        meta = self.describe()
+        meta = self.describe(retries=retries)
         if meta is None:
             return None
         return meta.get("state", "UNKNOWN")

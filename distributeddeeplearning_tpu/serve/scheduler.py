@@ -46,9 +46,10 @@ class CompletedRequest:
     uid: str
     prompt_len: int
     tokens: List[int]
-    finish_reason: str  # "eos" | "length"
+    finish_reason: str  # "eos" | "length" | "error"
     ttft_s: float
     total_s: float
+    error: Optional[str] = None  # set when finish_reason == "error"
 
 
 @dataclasses.dataclass
@@ -75,6 +76,9 @@ class ServeReport:
     decode_step_s: Dict[str, float]
     slot_occupancy_mean: float
     finish_reasons: Dict[str, int]
+    # requests that ended with finish_reason == "error" (per-request fault
+    # isolation: one bad request must not kill the batch)
+    errors: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -178,7 +182,13 @@ class ContinuousBatchingScheduler:
         prompt_tokens = 0
         finish_reasons: Dict[str, int] = {}
 
-        def complete(slot: int, st: _SlotState, reason: str) -> None:
+        error_count = 0
+
+        def complete(
+            slot: int, st: _SlotState, reason: str,
+            error: Optional[str] = None,
+        ) -> None:
+            nonlocal error_count
             now = time.perf_counter()
             results.append(
                 CompletedRequest(
@@ -188,11 +198,35 @@ class ContinuousBatchingScheduler:
                     finish_reason=reason,
                     ttft_s=st.ttft_s,
                     total_s=round(now - t_start, 6),
+                    error=error,
                 )
             )
             finish_reasons[reason] = finish_reasons.get(reason, 0) + 1
+            if reason == "error":
+                error_count += 1
             del active[slot]
             free.append(slot)
+
+        def fail_request(req: Request, exc: BaseException) -> None:
+            """Per-request fault isolation: record the failure, keep serving.
+
+            The slot was never (successfully) written, so it goes straight
+            back to the free list — the remaining traffic is unaffected.
+            """
+            nonlocal error_count
+            results.append(
+                CompletedRequest(
+                    uid=req.uid,
+                    prompt_len=len(req.prompt),
+                    tokens=[],
+                    finish_reason="error",
+                    ttft_s=0.0,
+                    total_s=round(time.perf_counter() - t_start, 6),
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+            )
+            finish_reasons["error"] = finish_reasons.get("error", 0) + 1
+            error_count += 1
 
         while pending or active:
             # Admit prompts into free slots — mid-flight: slots released in
@@ -201,7 +235,12 @@ class ContinuousBatchingScheduler:
                 req = pending.popleft()
                 slot = free.pop()
                 prompt_tokens += len(req.prompt)
-                first = engine.prefill(slot, req.prompt)
+                try:
+                    first = engine.prefill(slot, req.prompt)
+                except Exception as exc:  # noqa: BLE001 — isolate per request
+                    fail_request(req, exc)
+                    free.append(slot)
+                    continue
                 st = _SlotState(
                     req=req,
                     budget=(
@@ -226,7 +265,19 @@ class ContinuousBatchingScheduler:
                 pos_buf[slot] = st.next_pos
             occupancy.append(len(active) / slots)
             t0 = time.perf_counter()
-            out = engine.decode(tokens_buf, pos_buf)
+            try:
+                out = engine.decode(tokens_buf, pos_buf)
+            except Exception as exc:  # noqa: BLE001
+                # The decode step is batch-wide: a raise poisons every
+                # ACTIVE slot's cache position, so those requests complete
+                # as errors — but the queue keeps draining into the freed
+                # slots instead of the whole run() dying.
+                for slot, st in list(active.items()):
+                    complete(
+                        slot, st, "error",
+                        error=f"decode failed: {type(exc).__name__}: {exc}",
+                    )
+                continue
             step_times.append(time.perf_counter() - t0)
 
             for slot, st in list(active.items()):
@@ -252,5 +303,6 @@ class ContinuousBatchingScheduler:
                 round(float(np.mean(occupancy)), 4) if occupancy else 0.0
             ),
             finish_reasons=finish_reasons,
+            errors=error_count,
         )
         return results, report

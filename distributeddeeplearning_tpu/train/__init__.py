@@ -4,6 +4,17 @@ from distributeddeeplearning_tpu.train.schedule import (
     goyal_lr_schedule,
     scale_base_lr,
 )
+from distributeddeeplearning_tpu.train.resilience import (
+    RESUMABLE_EXIT_CODE,
+    WATCHDOG_EXIT_CODE,
+    AnomalyDetector,
+    AnomalyError,
+    PreemptionError,
+    PreemptionGuard,
+    RestartableError,
+    StepWatchdog,
+    supervise,
+)
 from distributeddeeplearning_tpu.train.state import TrainState, create_train_state
 from distributeddeeplearning_tpu.train.step import (
     build_eval_step,
@@ -17,4 +28,13 @@ __all__ = [
     "create_train_state",
     "build_train_step",
     "build_eval_step",
+    "RESUMABLE_EXIT_CODE",
+    "WATCHDOG_EXIT_CODE",
+    "AnomalyDetector",
+    "AnomalyError",
+    "PreemptionError",
+    "PreemptionGuard",
+    "RestartableError",
+    "StepWatchdog",
+    "supervise",
 ]

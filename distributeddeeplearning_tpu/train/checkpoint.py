@@ -26,6 +26,9 @@ from typing import Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from distributeddeeplearning_tpu.utils import faults as faults_mod
+from distributeddeeplearning_tpu.utils.retry import retry_call
+
 logger = logging.getLogger("ddlt.checkpoint")
 
 PyTree = Any
@@ -73,9 +76,23 @@ class Checkpointer:
         }
 
     def save(self, step: int, state) -> bool:
-        """Save if the manager's policy wants this step. Returns True if saved."""
-        saved = self._mgr.save(
-            step, args=ocp.args.StandardSave(self._arrays_of(state))
+        """Save if the manager's policy wants this step. Returns True if saved.
+
+        Transient storage errors are retried with bounded jittered backoff
+        (``utils/retry.py``) before propagating — at pod scale a flaky
+        gs:// write must not kill a run that could have checkpointed on the
+        next attempt.  The ``checkpoint.save`` fault-injection site
+        (``utils/faults.py``) exercises this path in tests.
+        """
+        arrays = self._arrays_of(state)
+
+        def _save() -> bool:
+            faults_mod.get_plan().maybe_io_error("checkpoint.save")
+            return self._mgr.save(step, args=ocp.args.StandardSave(arrays))
+
+        saved = retry_call(
+            _save, retries=2, base_delay=0.2, max_delay=2.0,
+            description=f"checkpoint save (step {step})",
         )
         if saved:
             logger.info("checkpoint saved at step %d -> %s", step, self.directory)
@@ -140,7 +157,18 @@ class Checkpointer:
         return restored["params"], step
 
     def wait(self) -> None:
-        self._mgr.wait_until_finished()
+        """Drain pending async saves, retrying transient storage failures
+        (same policy as :meth:`save`; the emergency-checkpoint path calls
+        this synchronously inside the preemption grace window)."""
+
+        def _wait() -> None:
+            faults_mod.get_plan().maybe_io_error("checkpoint.wait")
+            self._mgr.wait_until_finished()
+
+        retry_call(
+            _wait, retries=2, base_delay=0.2, max_delay=2.0,
+            description="checkpoint wait",
+        )
 
     def close(self) -> None:
         self._mgr.close()

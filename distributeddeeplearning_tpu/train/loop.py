@@ -30,6 +30,15 @@ import numpy as np
 from distributeddeeplearning_tpu.parallel.distributed import is_primary
 from distributeddeeplearning_tpu.parallel.sharding import shard_batch
 from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
+from distributeddeeplearning_tpu.train.resilience import (
+    AnomalyDetector,
+    AnomalyError,
+    PreemptionError,
+    PreemptionGuard,
+    StepWatchdog,
+)
+from distributeddeeplearning_tpu.utils import faults as faults_mod
+from distributeddeeplearning_tpu.utils.retry import RateLimitedLogger, retry_call
 from distributeddeeplearning_tpu.utils.throughput import ExamplesPerSecondTracker
 
 logger = logging.getLogger("ddlt.train")
@@ -52,6 +61,10 @@ class MetricsLog:
     """Append-only JSONL of per-epoch metric rows (AML ``run.log_row`` role).
 
     Rank-0 only; best-effort — a failing log write must never kill training.
+    Writes go through the bounded-backoff retry helper (``utils/retry.py``)
+    so transient storage errors don't silently eat rows; a row dropped after
+    exhausting retries is logged once a minute at most (rate-limited), with
+    a running ``dropped_rows`` count.
     GCS objects are immutable, so the gs:// path keeps the accumulated rows
     in memory (seeded once from an existing file on resume) and rewrites the
     small object per append — one upload, no per-epoch re-read.
@@ -60,6 +73,11 @@ class MetricsLog:
     def __init__(self, path: Optional[str]):
         self.path = path if (path and is_primary()) else None
         self._buffer = ""
+        self.dropped_rows = 0
+        # At most one "rows are being dropped" line a minute: the log
+        # stream that still works must not be flooded by the one that
+        # doesn't.
+        self._drop_warn = RateLimitedLogger(logger.warning, min_interval_s=60.0)
         if self.path is None:
             return
         if self.path.startswith("gs://"):
@@ -76,6 +94,18 @@ class MetricsLog:
 
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
 
+    def _write(self, line: str) -> None:
+        faults_mod.get_plan().maybe_io_error("metrics")
+        if self.path.startswith("gs://"):
+            import tensorflow as tf
+
+            with tf.io.gfile.GFile(self.path, "w") as f:
+                f.write(self._buffer + line)
+            self._buffer += line  # only on success: a retry resends the row
+        else:
+            with open(self.path, "a") as f:
+                f.write(line)
+
     def append(self, row: Dict[str, Any]) -> None:
         if self.path is None:
             return
@@ -83,17 +113,17 @@ class MetricsLog:
 
         line = json.dumps(row) + "\n"
         try:
-            if self.path.startswith("gs://"):
-                import tensorflow as tf
-
-                self._buffer += line
-                with tf.io.gfile.GFile(self.path, "w") as f:
-                    f.write(self._buffer)
-            else:
-                with open(self.path, "a") as f:
-                    f.write(line)
-        except Exception as exc:  # pragma: no cover - environment-specific
-            logger.warning("metrics log write failed (%s): %s", self.path, exc)
+            retry_call(
+                self._write, line,
+                retries=3, base_delay=0.05, max_delay=2.0,
+                description=f"metrics append ({self.path})",
+            )
+        except Exception as exc:  # environment-specific storage failures
+            self.dropped_rows += 1
+            self._drop_warn(
+                "metrics row dropped after retries (%s rows dropped so far, "
+                "path %s): %s", self.dropped_rows, self.path, exc,
+            )
 
 
 class TensorBoardLogger:
@@ -156,6 +186,34 @@ class TrainerConfig:
     # ImageNet-val-sized eval splits; raise it deliberately for bigger eval
     # sets (or set eval_steps, which bounds the drain outright).
     eval_buffer_batches: int = 4096
+    # ---- resilience knobs (train/resilience.py) ------------------------
+    # Preemption guard: SIGTERM/SIGINT set a flag the hot loop checks each
+    # step; on the next boundary a SYNCHRONOUS emergency checkpoint is
+    # written and PreemptionError raised (exit code 75 — EX_TEMPFAIL —
+    # under the workload runner, the signal a supervisor restarts on).
+    # None = auto: enabled exactly when a checkpoint_dir is configured.
+    preemption_guard: Optional[bool] = None
+    # Host-side anomaly detection: abort (AnomalyError) after this many
+    # CONSECUTIVE non-finite loss/grad-norm steps; isolated blips are
+    # counted and tolerated.  None = off.  Costs one device sync per step;
+    # pair it with build_train_step(skip_nonfinite=True) so the anomalous
+    # update is also DISCARDED on device (otherwise detection sees the NaN
+    # only after it has already poisoned the params).
+    anomaly_max_consecutive: Optional[int] = None
+    # On AnomalyError, restore the last checkpoint and keep training (at
+    # most anomaly_max_rollbacks times per fit) instead of propagating.
+    # Requires a checkpointer with at least one saved step and resume=True;
+    # with a plain-iterator data stream the rollback replays from wherever
+    # the stream happens to be (the step-indexed factory form is exact).
+    anomaly_rollback: bool = False
+    anomaly_max_rollbacks: int = 1
+    # Hot-loop watchdog: if the gap between completed steps exceeds this
+    # many seconds, dump all-thread stacks to stderr and hard-exit 70 (the
+    # hung-collective killer on multi-host meshes — one dead host blocks
+    # every other host INSIDE an XLA collective with no exception).  Arms
+    # after the first step of each epoch (compile excluded) and disarms
+    # across eval/checkpoint phases.  None = off.
+    step_deadline_s: Optional[float] = None
 
 
 def _drain_bounded(batches: Iterator, limit, cap: int) -> list:
@@ -184,6 +242,10 @@ class FitResult:
     final_eval_metrics: Optional[Dict[str, float]]
     total_images: int
     train_wall_seconds: float
+    # resilience accounting: non-finite steps whose update was skipped, and
+    # checkpoint rollbacks taken by the anomaly handler during this fit
+    anomalous_steps: int = 0
+    rollbacks: int = 0
 
     @property
     def images_per_second(self) -> float:
@@ -230,57 +292,164 @@ class Trainer:
         cache).  A plain iterator resumes wherever the stream happens to be
         (the r03 behavior): correct for IID-shuffled repeat streams, but
         not bit-reproducible against an uninterrupted run.
+
+        Resilience wiring (all opt-in via TrainerConfig; see
+        ``train/resilience.py``): a PreemptionGuard converting SIGTERM into
+        emergency-checkpoint + PreemptionError, an AnomalyDetector over
+        per-step loss/grad-norm with optional rollback-to-last-checkpoint,
+        a StepWatchdog deadline on hot-loop progress, and the
+        ``DDLT_FAULTS`` injection hooks that exercise all of it in tests.
         """
         cfg = self.config
-        start_epoch = 0
-        start_step_in_epoch = 0
-        restored_step = None
-        if self.checkpointer is not None and cfg.resume:
-            state, restored_step = self.checkpointer.restore(state)
-            if restored_step is not None:
-                start_epoch = int(restored_step) // cfg.steps_per_epoch
-                start_step_in_epoch = int(restored_step) % cfg.steps_per_epoch
-                if is_primary():
-                    logger.info(
-                        "resuming from step %d (epoch %d, step %d within it)",
-                        restored_step, start_epoch, start_step_in_epoch,
-                    )
-        if callable(train_batches) and not hasattr(train_batches, "__next__"):
-            train_batches = train_batches(int(restored_step or 0))
+        plan = faults_mod.get_plan()
+        factory = (
+            train_batches
+            if callable(train_batches) and not hasattr(train_batches, "__next__")
+            else None
+        )
+        stream = None if factory is not None else train_batches
 
-        owned_prefetch = None
-        if cfg.prefetch > 0:
-            from distributeddeeplearning_tpu.utils.prefetch import (
-                prefetch_to_device,
+        use_guard = cfg.preemption_guard
+        if use_guard is None:
+            use_guard = self.checkpointer is not None
+        guard = PreemptionGuard().install() if use_guard else None
+        if plan and guard is None and any(
+            s.kind == "preempt" for s in plan.specs
+        ):
+            logger.warning(
+                "DDLT_FAULTS contains a preempt fault but the preemption "
+                "guard is disabled (no checkpoint_dir?) — it will not fire"
             )
+        detector = (
+            AnomalyDetector(cfg.anomaly_max_consecutive)
+            if cfg.anomaly_max_consecutive
+            else None
+        )
+        watchdog = (
+            StepWatchdog(cfg.step_deadline_s).start()
+            if cfg.step_deadline_s
+            else None
+        )
 
-            train_batches = owned_prefetch = prefetch_to_device(
-                train_batches, self.mesh, size=cfg.prefetch
-            )
-
+        rollbacks = 0
         try:
-            return self._fit_inner(
-                state, train_batches, eval_batches_factory, start_epoch,
-                start_step_in_epoch,
-            )
+            while True:
+                start_epoch = 0
+                start_step_in_epoch = 0
+                restored_step = None
+                if self.checkpointer is not None and cfg.resume:
+                    state, restored_step = self.checkpointer.restore(state)
+                    if restored_step is not None:
+                        start_epoch = int(restored_step) // cfg.steps_per_epoch
+                        start_step_in_epoch = (
+                            int(restored_step) % cfg.steps_per_epoch
+                        )
+                        if is_primary():
+                            logger.info(
+                                "resuming from step %d (epoch %d, step %d "
+                                "within it)",
+                                restored_step, start_epoch,
+                                start_step_in_epoch,
+                            )
+                batches = (
+                    factory(int(restored_step or 0))
+                    if factory is not None
+                    else stream
+                )
+                if plan:
+                    batches = plan.wrap_data(
+                        batches, start_step=int(restored_step or 0)
+                    )
+
+                owned_prefetch = None
+                if cfg.prefetch > 0:
+                    from distributeddeeplearning_tpu.utils.prefetch import (
+                        prefetch_to_device,
+                    )
+
+                    batches = owned_prefetch = prefetch_to_device(
+                        batches, self.mesh, size=cfg.prefetch
+                    )
+
+                try:
+                    state, result = self._fit_inner(
+                        state, batches, eval_batches_factory, start_epoch,
+                        start_step_in_epoch, guard=guard, detector=detector,
+                        watchdog=watchdog, plan=plan,
+                    )
+                    result.rollbacks = rollbacks
+                    return state, result
+                except AnomalyError as exc:
+                    if watchdog is not None:
+                        # the rollback restore below is storage-bound, not
+                        # hot-loop progress
+                        watchdog.pause()
+                    # The live (finite, thanks to the in-jit guard) state is
+                    # the restore template for the rollback pass.
+                    state = getattr(exc, "state", state)
+                    can_roll = (
+                        cfg.anomaly_rollback
+                        and cfg.resume
+                        and self.checkpointer is not None
+                        and self.checkpointer.latest_step() is not None
+                        and rollbacks < cfg.anomaly_max_rollbacks
+                    )
+                    if not can_roll:
+                        raise
+                    rollbacks += 1
+                    detector = AnomalyDetector(cfg.anomaly_max_consecutive)
+                    logger.warning(
+                        "anomaly abort at step %s — rolling back to "
+                        "checkpoint step %s (%d/%d rollbacks)",
+                        exc.step, self.checkpointer.latest_step(),
+                        rollbacks, cfg.anomaly_max_rollbacks,
+                    )
+                finally:
+                    if owned_prefetch is not None:
+                        # Stop the worker deterministically: without the
+                        # close, the thread keeps decoding and device_put-ing
+                        # past what fit consumed (and keeps running during
+                        # error handling if the loop raised).
+                        owned_prefetch.close()
+                    if self.checkpointer is not None:
+                        # Drain pending async saves even when the loop raised
+                        # (data stream died, preemption signal, ...): the
+                        # state snapshots were already copied to host, and
+                        # finalizing them is the difference between resuming
+                        # at the last checkpoint_every_steps boundary and
+                        # losing it.
+                        self.checkpointer.wait()
         finally:
-            if owned_prefetch is not None:
-                # Stop the worker deterministically: without the close, the
-                # thread keeps decoding and device_put-ing past what fit
-                # consumed (and keeps running during error handling if the
-                # loop raised).
-                owned_prefetch.close()
-            if self.checkpointer is not None:
-                # Drain pending async saves even when the loop raised (data
-                # stream died, preemption signal, ...): the state snapshots
-                # were already copied to host, and finalizing them is the
-                # difference between resuming at the last
-                # checkpoint_every_steps boundary and losing it.
-                self.checkpointer.wait()
+            if watchdog is not None:
+                watchdog.stop()
+            if guard is not None:
+                guard.uninstall()
+
+    def _emergency_stop(self, step: int, state, watchdog) -> None:
+        """Preemption noticed at a step boundary: synchronous emergency
+        checkpoint, then PreemptionError (→ exit 75 under the runner)."""
+        if watchdog is not None:
+            watchdog.pause()
+        if self.checkpointer is not None:
+            logger.warning(
+                "preemption at step %d — writing emergency checkpoint", step
+            )
+            # save() copies device→host synchronously; wait() drains the
+            # background write.  Both must land BEFORE the resumable exit:
+            # the grace window is short and the checkpoint IS the recovery.
+            self.checkpointer.save(step, state)
+            self.checkpointer.wait()
+            logger.warning("emergency checkpoint at step %d complete", step)
+        raise PreemptionError(
+            f"preempted at step {step} (emergency checkpoint "
+            f"{'written' if self.checkpointer is not None else 'UNAVAILABLE'})",
+            step=step,
+        )
 
     def _fit_inner(
         self, state, train_batches, eval_batches_factory, start_epoch,
-        start_step_in_epoch=0,
+        start_step_in_epoch=0, *, guard=None, detector=None, watchdog=None,
+        plan=None,
     ) -> tuple:
         cfg = self.config
         tracker = ExamplesPerSecondTracker(
@@ -309,6 +478,7 @@ class Trainer:
             )
             profile_start = 0
         global_step = 0
+        anomalous_total = 0
 
         for epoch in range(start_epoch, cfg.epochs):
             # Metrics accumulate ON DEVICE (one tiny async add per step);
@@ -319,16 +489,49 @@ class Trainer:
             epoch_t0 = time.monotonic()
             first_step = start_step_in_epoch if epoch == start_epoch else 0
             steps_this_epoch = cfg.steps_per_epoch - first_step
+            anomalous_this_epoch = 0
             for step_i in range(first_step, cfg.steps_per_epoch):
+                true_step = epoch * cfg.steps_per_epoch + step_i + 1
                 if profile_pending and global_step >= profile_start:
                     jax.profiler.start_trace(cfg.profile_dir)
                     profile_active, profile_pending = True, False
-                batch = shard_batch(self.mesh, next(train_batches))
+                host_batch = next(train_batches)
+                if plan:
+                    host_batch = plan.poison_batch(true_step, host_batch)
+                batch = shard_batch(self.mesh, host_batch)
                 state, metrics = self.train_step(state, batch)
-                acc = metrics if acc is None else _acc_add(acc, metrics)
+                anomalous = False
+                if detector is not None:
+                    # One host sync per step — the price of reacting to a
+                    # diverging run before it wastes the rest of the epoch.
+                    loss_v = float(metrics["loss"])
+                    gn = metrics.get("grad_norm")
+                    flagged = metrics.get("anomalous")
+                    try:
+                        anomalous = detector.observe(
+                            true_step, loss_v,
+                            float(gn) if gn is not None else None,
+                            flagged=(
+                                bool(float(flagged))
+                                if flagged is not None else None
+                            ),
+                        )
+                    except AnomalyError as exc:
+                        exc.state = state  # restore template for rollback
+                        raise
+                if anomalous:
+                    # NaN metrics must not poison the epoch accumulator
+                    # (the on-device update was already skipped when the
+                    # step was built with skip_nonfinite=True).
+                    anomalous_this_epoch += 1
+                    anomalous_total += 1
+                else:
+                    acc = metrics if acc is None else _acc_add(acc, metrics)
                 if (step_i + 1) % cfg.log_every == 0:
                     jax.block_until_ready(acc)
                 tracker.after_step()
+                if watchdog is not None:
+                    watchdog.tick()
                 total_images += cfg.global_batch_size
                 global_step += 1
                 if profile_active and global_step >= (
@@ -341,15 +544,23 @@ class Trainer:
                 if (
                     self.checkpointer is not None
                     and cfg.checkpoint_every_steps
-                    and (epoch * cfg.steps_per_epoch + step_i + 1)
-                    % cfg.checkpoint_every_steps == 0
+                    and true_step % cfg.checkpoint_every_steps == 0
                 ):
+                    if watchdog is not None:
+                        # storage-bound phase: save() can block on the
+                        # previous in-flight async write (plus its retry
+                        # backoff) — not hot-loop hang evidence.  The next
+                        # step's tick re-arms.
+                        watchdog.pause()
                     # save() copies device→host synchronously, so the next
                     # step's donation cannot clobber the saved buffers; the
                     # serialize/write happens on orbax's background thread.
-                    self.checkpointer.save(
-                        epoch * cfg.steps_per_epoch + step_i + 1, state
-                    )
+                    self.checkpointer.save(true_step, state)
+                if guard is not None:
+                    if plan:
+                        plan.maybe_preempt(true_step, guard)
+                    if guard.preempted():
+                        self._emergency_stop(true_step, state, watchdog)
             if profile_active:
                 # Run shorter than the window: close the trace on step work
                 # only — eval/checkpoint/TB below must not pollute it.
@@ -357,9 +568,19 @@ class Trainer:
                 jax.profiler.stop_trace()
                 profile_active = False
                 logger.info("profiler trace written to %s", cfg.profile_dir)
-            train_metrics = {
-                k: float(v) / steps_this_epoch for k, v in acc.items()
-            }
+            if watchdog is not None:
+                # Eval, TB, checkpoints below have unbounded (storage-
+                # dependent) duration; the deadline re-arms at the next
+                # epoch's first completed step.
+                watchdog.pause()
+            counted_steps = steps_this_epoch - anomalous_this_epoch
+            train_metrics = (
+                {k: float(v) / counted_steps for k, v in acc.items()}
+                if acc is not None and counted_steps > 0
+                else {}
+            )
+            if anomalous_this_epoch:
+                train_metrics["anomalous_steps"] = float(anomalous_this_epoch)
             # train-phase wall of THIS epoch (the float() above synced):
             # excludes the eval/checkpoint below, so per-epoch throughput
             # rows are comparable across epochs.
@@ -411,6 +632,7 @@ class Trainer:
             final_eval_metrics=eval_metrics,
             total_images=total_images,
             train_wall_seconds=wall,
+            anomalous_steps=anomalous_total,
         )
         if is_primary() and total_images:
             # _log_summary parity (resnet_main.py:184-200)

@@ -1,0 +1,337 @@
+"""Fault detection and reaction: preemption guard, anomaly detector,
+step watchdog, and the restart supervisor.
+
+The framework could already *resume* to the exact step (orbax checkpoints +
+the Trainer's step-indexed factory contract) and *recreate* a preempted pod
+(``TpuPod.recreate``) — but nothing detected a fault or reacted to one.
+This module is the reaction layer; :mod:`..utils.faults` is how every path
+in it gets exercised on CPU in tier-1 tests.
+
+Exit-code contract (what a supervisor — ``ddlt train --max-restarts``, a
+k8s restart policy, the control-plane retry loop — keys off):
+
+- ``RESUMABLE_EXIT_CODE`` (75, BSD ``EX_TEMPFAIL``): the run checkpointed
+  its exact step and asks to be restarted — emitted on preemption after
+  the emergency checkpoint lands.
+- ``WATCHDOG_EXIT_CODE`` (70, ``EX_SOFTWARE``): a hot-loop step blew its
+  deadline (hung collective, dead remote host); all-thread stacks were
+  dumped to stderr first.  Restarting may help; the stacks say why.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import logging
+import math
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+logger = logging.getLogger("ddlt.resilience")
+
+RESUMABLE_EXIT_CODE = 75  # EX_TEMPFAIL: checkpointed, restart me
+WATCHDOG_EXIT_CODE = 70   # EX_SOFTWARE: step deadline blown, stacks dumped
+
+
+class RestartableError(RuntimeError):
+    """A failure after which restart-from-latest-checkpoint is the fix."""
+
+    def __init__(self, msg: str, *, step: Optional[int] = None):
+        super().__init__(msg)
+        self.step = step
+
+
+class PreemptionError(RestartableError):
+    """Raised by the train loop AFTER the emergency checkpoint landed."""
+
+
+class AnomalyError(RestartableError):
+    """Too many consecutive non-finite steps — the model is diverging."""
+
+    def __init__(self, msg: str, *, step: Optional[int] = None,
+                 consecutive: int = 0):
+        super().__init__(msg, step=step)
+        self.consecutive = consecutive
+
+
+class PreemptionGuard:
+    """SIGTERM/SIGINT → a flag the hot loop checks each step.
+
+    TPU preemptions deliver SIGTERM with a short grace window; an unhandled
+    one kills the process mid-step and loses everything since the last
+    periodic checkpoint.  The guard converts the signal into cooperative
+    shutdown: the handler only sets a flag (async-signal-safe), the step
+    loop notices it at the next boundary, writes a **synchronous** emergency
+    checkpoint, and raises :class:`PreemptionError` so the process can exit
+    with :data:`RESUMABLE_EXIT_CODE`.
+
+    A second SIGINT falls through to the previous handler (double Ctrl-C
+    still kills an interactive run immediately).
+    """
+
+    def __init__(self, signals: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT)):
+        self.signals = signals
+        self._flag = threading.Event()
+        self.reason: Optional[str] = None
+        self._previous: dict = {}
+        self.installed = False
+
+    def install(self) -> "PreemptionGuard":
+        """Install handlers; no-op off the main thread (signal.signal would
+        raise there — embedding callers just lose signal coverage, and
+        injected preemptions still work via :meth:`trigger`)."""
+        if self.installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            logger.warning(
+                "PreemptionGuard: not on the main thread; signal handlers "
+                "not installed (injected preemptions still honored)"
+            )
+            return self
+        for sig in self.signals:
+            self._previous[sig] = signal.signal(sig, self._handle)
+        self.installed = True
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._previous.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):  # non-main thread / exotic prev
+                pass
+        self._previous.clear()
+        self.installed = False
+
+    def _handle(self, signum, frame) -> None:
+        if self._flag.is_set() and signum == signal.SIGINT:
+            # Second Ctrl-C: the operator means it.
+            prev = self._previous.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                raise KeyboardInterrupt
+        self.reason = f"signal {signal.Signals(signum).name}"
+        self._flag.set()
+
+    def trigger(self, reason: str = "triggered") -> None:
+        """Programmatic preemption (fault injection, tests)."""
+        self.reason = reason
+        self._flag.set()
+
+    def preempted(self) -> bool:
+        return self._flag.is_set()
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+class AnomalyDetector:
+    """Count non-finite loss/grad-norm steps; abort on a consecutive run.
+
+    The jitted step (``build_train_step(skip_nonfinite=True)``) already
+    *skips* the poisoned update on-device; this host-side detector decides
+    whether the run is still healthy: isolated blips are counted and
+    tolerated, ``max_consecutive`` anomalous steps in a row raise
+    :class:`AnomalyError` (which the Trainer can answer with a rollback to
+    the last checkpoint, or a supervisor with a restart).
+    """
+
+    def __init__(self, max_consecutive: int = 3):
+        if max_consecutive < 1:
+            raise ValueError(
+                f"max_consecutive must be >= 1, got {max_consecutive}"
+            )
+        self.max_consecutive = max_consecutive
+        self.total = 0
+        self.consecutive = 0
+
+    def observe(
+        self,
+        step: int,
+        loss: float,
+        grad_norm: Optional[float] = None,
+        flagged: Optional[bool] = None,
+    ) -> bool:
+        """Record one step's health; returns True when the step is anomalous.
+
+        ``flagged`` is the step's own non-finite verdict when the jitted
+        guard computed one; otherwise finiteness of ``loss``/``grad_norm``
+        decides.
+        """
+        anomalous = bool(flagged) if flagged is not None else (
+            not math.isfinite(loss)
+            or (grad_norm is not None and not math.isfinite(grad_norm))
+        )
+        if not anomalous:
+            self.consecutive = 0
+            return False
+        self.total += 1
+        self.consecutive += 1
+        logger.warning(
+            "anomalous step %d (loss=%s, grad_norm=%s): update skipped "
+            "(%d consecutive, %d total)",
+            step, loss, grad_norm, self.consecutive, self.total,
+        )
+        if self.consecutive >= self.max_consecutive:
+            raise AnomalyError(
+                f"{self.consecutive} consecutive non-finite steps "
+                f"(last: step {step}, loss={loss})",
+                step=step, consecutive=self.consecutive,
+            )
+        return True
+
+
+def dump_all_stacks(out=None) -> None:
+    """Write every thread's Python stack to ``out`` (default stderr).
+
+    The one artifact that explains a hung collective: which thread sits in
+    which blocking call on THIS host when the deadline blew.
+    """
+    out = out if out is not None else sys.stderr
+    try:
+        faulthandler.dump_traceback(file=out, all_threads=True)
+    except Exception:  # out may be a text-only buffer without fileno
+        import traceback
+
+        frames = sys._current_frames()
+        for tid, frame in frames.items():
+            out.write(f"\n--- thread {tid} ---\n")
+            out.write("".join(traceback.format_stack(frame)))
+    try:
+        out.flush()
+    except Exception:
+        pass
+
+
+class StepWatchdog:
+    """Background deadline on hot-loop progress — the hung-collective killer.
+
+    On a multi-host mesh one dead host leaves every other host blocked
+    *inside* an XLA collective: no exception, no log line, the job burns
+    budget until an outer timeout.  The watchdog thread fires when the gap
+    between ``tick()`` calls exceeds ``deadline_s``: it dumps all-thread
+    stacks and (by default) hard-exits with :data:`WATCHDOG_EXIT_CODE` so a
+    supervisor restarts the run — ``on_timeout`` overrides the exit for
+    embedding/tests.
+
+    The watchdog arms on the FIRST ``tick()``: step 0 includes XLA
+    compilation, whose duration has nothing to do with the steady-state
+    deadline.  ``pause()`` disarms across known-slow phases (eval,
+    epoch-end checkpoints); the next ``tick()`` re-arms.
+    """
+
+    def __init__(
+        self,
+        deadline_s: float,
+        *,
+        on_timeout: Optional[Callable[[], None]] = None,
+        poll_s: Optional[float] = None,
+        stream=None,
+    ):
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        self.deadline_s = deadline_s
+        self.on_timeout = on_timeout
+        self._poll_s = poll_s if poll_s is not None else min(deadline_s / 4, 1.0)
+        self._stream = stream
+        self._last_tick: Optional[float] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired = False
+
+    def start(self) -> "StepWatchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._watch, name="ddlt-step-watchdog", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def tick(self) -> None:
+        """A step completed; reset (and arm) the deadline."""
+        with self._lock:
+            self._last_tick = time.monotonic()
+
+    def pause(self) -> None:
+        """Disarm until the next tick (eval, checkpoint, epoch boundary)."""
+        with self._lock:
+            self._last_tick = None
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._poll_s * 4)
+            self._thread = None
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            with self._lock:
+                last = self._last_tick
+            if last is None:
+                continue
+            elapsed = time.monotonic() - last
+            if elapsed <= self.deadline_s:
+                continue
+            self.fired = True
+            stream = self._stream if self._stream is not None else sys.stderr
+            print(
+                f"ddlt watchdog: no step progress for {elapsed:.1f}s "
+                f"(deadline {self.deadline_s}s) — dumping all thread stacks",
+                file=stream,
+            )
+            dump_all_stacks(stream)
+            if self.on_timeout is not None:
+                self.on_timeout()
+                # custom handler chose to keep the process: disarm so a
+                # still-hung loop doesn't re-fire every poll interval
+                with self._lock:
+                    self._last_tick = None
+                continue
+            os._exit(WATCHDOG_EXIT_CODE)
+
+    def __enter__(self) -> "StepWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def supervise(
+    fn: Callable[[int], object],
+    *,
+    max_restarts: int = 0,
+    restart_on: Tuple[type, ...] = (RestartableError,),
+    on_restart: Optional[Callable[[int, BaseException], None]] = None,
+):
+    """In-process restart loop: call ``fn(attempt)``, restarting on
+    restartable failures up to ``max_restarts`` times.
+
+    This is the single-process half of the supervision story (``ddlt train
+    --max-restarts``); the cross-process half is the exit-code contract plus
+    the control plane's resubmit loop.  ``fn`` must be restartable by
+    construction — i.e. resume from its own checkpoints — or the loop just
+    re-runs the failure.
+
+    Returns ``(result, restarts_used)``.  The final failure propagates.
+    """
+    restarts = 0
+    while True:
+        try:
+            return fn(restarts), restarts
+        except restart_on as exc:
+            if restarts >= max_restarts:
+                raise
+            restarts += 1
+            logger.warning(
+                "restartable failure (%s: %s) — restart %d/%d from latest "
+                "checkpoint", type(exc).__name__, exc, restarts, max_restarts,
+            )
+            if on_restart is not None:
+                on_restart(restarts, exc)

@@ -170,6 +170,7 @@ def build_train_step(
     moe_aux_weight: float = 0.01,  # Switch Transformer's α
     accum_steps: int = 1,
     input_transform: Optional[Callable] = None,
+    skip_nonfinite: bool = False,
 ) -> Callable:
     """Compile the full DP training step over ``mesh``.
 
@@ -199,6 +200,16 @@ def build_train_step(
     grad); BatchNorm models see ``accum_steps`` sequential EMA updates of
     batch statistics over microbatch moments instead of one global-batch
     moment — the standard, documented deviation.
+
+    ``skip_nonfinite`` arms the in-program anomaly guard (the resilience
+    layer's device half; ``train/resilience.py`` holds the host half): when
+    the loss or the global gradient norm is non-finite, the parameter /
+    optimizer / batch-stats update is **discarded inside the compiled step**
+    (``step`` still advances, so step accounting and resume stay exact) and
+    the metrics gain ``grad_norm`` plus an ``anomalous`` 0/1 flag the
+    Trainer's ``AnomalyDetector`` consumes.  Off by default: the extra
+    select is cheap but not free, and perf-critical runs should compile the
+    identical program they always did.
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -231,16 +242,39 @@ def build_train_step(
 
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
 
+        def guarded_update(grads, new_stats, loss):
+            """Apply the update only when loss and grad norm are finite;
+            step advances either way (resume/step accounting stay exact)."""
+            grad_norm = optax.global_norm(grads)
+            ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+            cand = state.apply_gradients(grads, batch_stats=new_stats)
+            skipped = state.replace(step=cand.step)
+            selected = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(ok, n, o), cand, skipped
+            )
+            guard_metrics = {
+                "grad_norm": grad_norm.astype(jnp.float32),
+                "anomalous": (1.0 - ok.astype(jnp.float32)),
+            }
+            return selected, guard_metrics
+
         if accum_steps == 1:
             (loss, (logits, new_stats)), grads = grad_fn(
                 state.params, state.batch_stats, inputs, labels, extras,
                 {"dropout": step_rng},
             )
-            new_state = state.apply_gradients(grads, batch_stats=new_stats)
+            guard_metrics = {}
+            if skip_nonfinite:
+                new_state, guard_metrics = guarded_update(
+                    grads, new_stats, loss
+                )
+            else:
+                new_state = state.apply_gradients(grads, batch_stats=new_stats)
             # Aux-head models (InceptionV3 aux_logits=True) return (main, aux);
             # metrics report on the main head only.
             main_logits = logits[0] if isinstance(logits, tuple) else logits
             metrics = metrics_fn(main_logits, labels, loss)
+            metrics.update(guard_metrics)
         else:
             if inputs.shape[0] % accum_steps:
                 raise ValueError(
@@ -292,10 +326,16 @@ def build_train_step(
             grads = jax.tree_util.tree_map(
                 lambda g, p: (g * inv).astype(p.dtype), grads_sum, state.params
             )
-            new_state = state.apply_gradients(grads, batch_stats=new_stats)
             metrics = jax.tree_util.tree_map(
                 lambda m: m.mean(axis=0), metrics_stack
             )
+            if skip_nonfinite:
+                new_state, guard_metrics = guarded_update(
+                    grads, new_stats, metrics["loss"]
+                )
+                metrics.update(guard_metrics)
+            else:
+                new_state = state.apply_gradients(grads, batch_stats=new_stats)
         if schedule is not None:
             metrics["lr"] = schedule(state.step).astype(jnp.float32)
         return new_state, metrics

@@ -6,18 +6,26 @@ from distributeddeeplearning_tpu.utils.metrics import (
     pmean_metrics,
     topk_correct,
 )
+from distributeddeeplearning_tpu.utils.retry import (
+    RateLimitedLogger,
+    backoff_delays,
+    retry_call,
+)
 from distributeddeeplearning_tpu.utils.throughput import ExamplesPerSecondTracker
 from distributeddeeplearning_tpu.utils.timer import Timer, timer
 
 __all__ = [
     "AverageMeter",
     "ExamplesPerSecondTracker",
+    "RateLimitedLogger",
     "Timer",
     "accuracy_topk",
+    "backoff_delays",
     "confidence_interval_95",
     "get_logger",
     "is_primary",
     "pmean_metrics",
+    "retry_call",
     "setup_logging",
     "timer",
     "topk_correct",

@@ -9,18 +9,24 @@ behind the device's execution of batch n (the flax ``prefetch_to_device``
 idiom, generalized to sharded global arrays via ``shard_batch``).
 
 Usage: wraps any host-batch iterator; yields device-resident sharded
-batches.  Bounded queue (backpressure); the worker thread dies with the
-consumer (daemon + sentinel), and worker exceptions re-raise at the
-consuming ``next()`` instead of vanishing.
+batches.  Bounded queue (backpressure); ``close()`` reaps the worker thread
+deterministically (draining the queue until the thread joins — a single
+``get_nowait`` could leave the worker blocked forever on ``put``), and
+worker exceptions re-raise at the consuming ``next()`` instead of
+vanishing.
 """
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
+import time
 from typing import Iterator
 
 from distributeddeeplearning_tpu.parallel.sharding import shard_batch
+
+logger = logging.getLogger("ddlt.prefetch")
 
 _SENTINEL = object()
 
@@ -30,48 +36,101 @@ class _WorkerError:
         self.exc = exc
 
 
-def prefetch_to_device(
-    batches: Iterator, mesh, *, size: int = 2
-) -> Iterator:
-    """Yield ``shard_batch(mesh, b)`` for each host batch ``b``, staged
-    ``size`` deep from a background thread.
+class PrefetchIterator:
+    """Iterator over device-staged batches with a reapable worker thread.
 
     The worker runs AHEAD of the consumer: up to ``size`` staged batches
     (plus one in flight) are pulled from ``batches`` beyond what has been
     yielded, and are dropped on close.  Fine for the framework's own
     restartable input_fns; callers handing in a shared or stateful iterator
-    should expect it to be consumed past the last yielded batch."""
-    if size < 1:
-        raise ValueError(f"prefetch size must be >= 1, got {size}")
-    q: "queue.Queue" = queue.Queue(maxsize=size)
-    stop = threading.Event()
+    should expect it to be consumed past the last yielded batch.
+    """
 
-    def worker():
+    def __init__(self, batches: Iterator, mesh, *, size: int = 2):
+        if size < 1:
+            raise ValueError(f"prefetch size must be >= 1, got {size}")
+        self._batches = batches
+        self._mesh = mesh
+        self._q: "queue.Queue" = queue.Queue(maxsize=size)
+        self._stop = threading.Event()
+        self._done = False
+        self._closed = False
+        self.thread = threading.Thread(
+            target=self._work, name="ddlt-prefetch", daemon=True
+        )
+        self.thread.start()
+
+    def _work(self) -> None:
         try:
-            for b in batches:
-                if stop.is_set():
+            for b in self._batches:
+                if self._stop.is_set():
                     return
-                q.put(shard_batch(mesh, b))
-            q.put(_SENTINEL)
+                self._q.put(shard_batch(self._mesh, b))
+            self._q.put(_SENTINEL)
         except BaseException as exc:  # noqa: BLE001 — re-raised at next()
-            q.put(_WorkerError(exc))
+            self._q.put(_WorkerError(exc))
 
-    thread = threading.Thread(
-        target=worker, name="ddlt-prefetch", daemon=True
-    )
-    thread.start()
-    try:
-        while True:
-            item = q.get()
-            if item is _SENTINEL:
-                return
-            if isinstance(item, _WorkerError):
-                raise item.exc
-            yield item
-    finally:
-        stop.set()
-        # Unblock a worker stuck on a full queue, then let it notice stop.
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        if self._closed:
+            raise RuntimeError("prefetch iterator used after close()")
+        item = self._q.get()
+        if item is _SENTINEL:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, _WorkerError):
+            self._done = True
+            raise item.exc
+        return item
+
+    def __del__(self):
+        # GC safety net matching the old generator's finalizer: unblock and
+        # release the worker WITHOUT joining (no blocking in a finalizer).
+        # Callers that care about deterministic reaping must call close().
         try:
-            q.get_nowait()
-        except queue.Empty:
+            self._stop.set()
+            while True:
+                self._q.get_nowait()
+        except Exception:
             pass
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop and reap the worker.
+
+        The worker can be blocked in ``q.put`` at any of its three put
+        sites (a staged batch, the sentinel, a captured error) — and a
+        single ``get_nowait`` only unblocks ONE of those before the queue
+        can refill.  So: set the stop flag, then drain the queue repeatedly
+        until the thread joins, bounded by ``timeout`` (a worker stuck
+        inside the underlying ``batches`` source cannot be interrupted; it
+        is daemonic and is reported, not waited on forever).
+        """
+        self._closed = True
+        if not self.thread.is_alive():
+            return
+        self._stop.set()
+        deadline = time.monotonic() + timeout
+        while self.thread.is_alive():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self.thread.join(timeout=0.05)
+            if time.monotonic() > deadline:
+                logger.warning(
+                    "prefetch worker did not exit within %.1fs of close() — "
+                    "blocked inside the input source? (daemon thread leaked)",
+                    timeout,
+                )
+                return
+
+
+def prefetch_to_device(batches: Iterator, mesh, *, size: int = 2) -> PrefetchIterator:
+    """Yield ``shard_batch(mesh, b)`` for each host batch ``b``, staged
+    ``size`` deep from a background thread.  Returns a
+    :class:`PrefetchIterator`; call ``close()`` to reap the worker."""
+    return PrefetchIterator(batches, mesh, size=size)

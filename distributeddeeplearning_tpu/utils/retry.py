@@ -1,0 +1,124 @@
+"""Bounded exponential backoff with full jitter — the I/O retry policy.
+
+At pod scale the storage and control planes fail *transiently* all the
+time: a gs:// write 503s, a gcloud describe times out, an orbax save hits
+a flaky filesystem.  The reference stack had no story for any of this
+(SURVEY §5); the failure either killed the run or vanished silently.  One
+policy, used by every caller that talks to the outside world
+(``MetricsLog``, ``Checkpointer``, ``CommandRunner``):
+
+- **bounded**: at most ``retries`` re-attempts, then the last exception
+  propagates (or the last failing result is returned) — retry loops must
+  never turn a hard failure into a hang;
+- **exponential with full jitter** (AWS architecture-blog recipe): the
+  attempt-``i`` sleep is drawn uniformly from ``[0, min(max_delay,
+  base_delay * 2**i)]``.  Full jitter decorrelates the retry herd a
+  preemption wave would otherwise synchronize across hosts.
+
+``sleep``/``rng`` are injectable so tests assert the bound without
+sleeping.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+from typing import Callable, Optional, Sequence, Tuple, Type
+
+logger = logging.getLogger("ddlt.retry")
+
+
+def backoff_delays(
+    retries: int,
+    *,
+    base_delay: float = 0.1,
+    max_delay: float = 5.0,
+    rng: Optional[random.Random] = None,
+):
+    """Yield the ``retries`` jittered sleeps of one retry sequence.
+
+    Exposed separately so the bound is testable as data: delay ``i`` is
+    uniform in ``[0, min(max_delay, base_delay * 2**i)]``.
+    """
+    rng = rng if rng is not None else random
+    for attempt in range(retries):
+        cap = min(max_delay, base_delay * (2.0 ** attempt))
+        yield rng.uniform(0.0, cap)
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    retries: int = 3,
+    base_delay: float = 0.1,
+    max_delay: float = 5.0,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    sleep: Callable[[float], None] = time.sleep,
+    rng: Optional[random.Random] = None,
+    description: str = "",
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)``; on ``retry_on`` retry up to ``retries``
+    times with full-jitter backoff.  The final failure re-raises.
+
+    ``description`` names the operation in the warning log lines;
+    ``on_retry(attempt, exc)`` observes each retry (metrics hooks, tests).
+    """
+    if retries < 0:
+        raise ValueError(f"retries must be >= 0, got {retries}")
+    delays = backoff_delays(
+        retries, base_delay=base_delay, max_delay=max_delay, rng=rng
+    )
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as exc:
+            if attempt >= retries:
+                raise
+            delay = next(delays)
+            attempt += 1
+            logger.warning(
+                "%s failed (%s); retry %d/%d in %.2fs",
+                description or getattr(fn, "__name__", "operation"),
+                exc, attempt, retries, delay,
+            )
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            sleep(delay)
+
+
+class RateLimitedLogger:
+    """Emit at most one log line per ``min_interval_s``, counting the rest.
+
+    The drop-path companion of :func:`retry_call`: when an append-only log
+    write keeps failing, the operator needs ONE line saying rows are being
+    dropped — not one line per dropped row flooding the very log stream
+    that still works.
+    """
+
+    def __init__(self, log: Callable, *, min_interval_s: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._log = log
+        self._min_interval_s = min_interval_s
+        self._clock = clock
+        self._last: Optional[float] = None
+        self.suppressed = 0
+        self.emitted = 0
+
+    def __call__(self, msg: str, *fmt_args) -> bool:
+        """Log ``msg`` if the interval allows; returns True when emitted."""
+        now = self._clock()
+        if self._last is not None and now - self._last < self._min_interval_s:
+            self.suppressed += 1
+            return False
+        suffix = (
+            f" ({self.suppressed} similar suppressed)" if self.suppressed else ""
+        )
+        self._log(msg + suffix, *fmt_args)
+        self._last = now
+        self.emitted += 1
+        self.suppressed = 0
+        return True

@@ -62,12 +62,8 @@ def parse_flags(argv: List[str]) -> Dict[str, str]:
     return kwargs
 
 
-def run_from_argv(
-    main_fn: Callable, argv: Optional[List[str]] = None
-) -> Any:
-    """Parse flags against ``main_fn``'s signature and call it."""
-    argv = sys.argv[1:] if argv is None else argv
-    raw_kwargs = parse_flags(argv)
+def coerce_flags(main_fn: Callable, raw_kwargs: Dict[str, str]) -> Dict[str, Any]:
+    """Coerce raw-string kwargs against ``main_fn``'s signature."""
     sig = inspect.signature(main_fn)
     kwargs: Dict[str, Any] = {}
     for key, raw in raw_kwargs.items():
@@ -83,4 +79,32 @@ def run_from_argv(
             kwargs[key] = _coerce(raw, default)
         except ValueError as exc:
             raise SystemExit(f"bad value for --{key}: {exc}")
-    return main_fn(**kwargs)
+    return kwargs
+
+
+def run_from_argv(
+    main_fn: Callable, argv: Optional[List[str]] = None
+) -> Any:
+    """Parse flags against ``main_fn``'s signature and call it.
+
+    Exit-code contract (train/resilience.py): a run that was preempted but
+    landed its emergency checkpoint exits ``RESUMABLE_EXIT_CODE`` (75,
+    EX_TEMPFAIL) — the distinct code a supervisor (``ddlt train
+    --max-restarts``, the control plane's resubmit loop, a k8s restart
+    policy) keys restarts off, as opposed to a real failure's rc=1.
+    """
+    argv = sys.argv[1:] if argv is None else argv
+    kwargs = coerce_flags(main_fn, parse_flags(argv))
+    from distributeddeeplearning_tpu.train.resilience import (
+        RESUMABLE_EXIT_CODE,
+        PreemptionError,
+    )
+
+    try:
+        return main_fn(**kwargs)
+    except PreemptionError as exc:
+        print(
+            f"preempted: {exc} — exiting {RESUMABLE_EXIT_CODE} (resumable)",
+            file=sys.stderr,
+        )
+        raise SystemExit(RESUMABLE_EXIT_CODE)

@@ -188,6 +188,11 @@ def main(
     metrics_path: Optional[str] = None,  # per-epoch JSONL rows (run.log_row)
     aux_logits: bool = False,  # InceptionV3 aux head, loss weighted 0.4
     num_slices: int = 1,  # multi-slice (DCN) data parallelism
+    # -- resilience (train/resilience.py; see TrainerConfig docstrings) --
+    skip_nonfinite: bool = False,  # in-step guard: discard non-finite updates
+    anomaly_max_consecutive: Optional[int] = None,  # abort after N in a row
+    anomaly_rollback: bool = False,  # restore last ckpt instead of aborting
+    step_deadline_s: Optional[float] = None,  # watchdog: stacks + exit 70
 ):
     """Train; returns (state, FitResult)."""
     import jax
@@ -253,7 +258,8 @@ def main(
     train_step = build_train_step(
         mesh, state, schedule=schedule, label_smoothing=label_smoothing,
         compute_dtype=dtype, rng=jax.random.key(seed + 1),
-        accum_steps=accum_steps, **step_kwargs,
+        accum_steps=accum_steps, skip_nonfinite=skip_nonfinite,
+        **step_kwargs,
     )
     eval_step = build_eval_step(
         mesh, state, compute_dtype=dtype,
@@ -301,6 +307,9 @@ def main(
             resume=resume,
             profile_dir=profile_dir,
             metrics_path=metrics_path,
+            anomaly_max_consecutive=anomaly_max_consecutive,
+            anomaly_rollback=anomaly_rollback,
+            step_deadline_s=step_deadline_s,
         ),
     )
     return trainer.fit(state, train_iter, eval_factory)

@@ -114,6 +114,11 @@ def main(
     # ring attention's blocked inner loop: bounds per-tick score memory at
     # O(Sq*block_k) — set for long-context launches (must divide S/seq)
     sp_block_k: Optional[int] = None,
+    # -- resilience (train/resilience.py; see TrainerConfig docstrings) --
+    skip_nonfinite: bool = False,  # in-step guard: discard non-finite updates
+    anomaly_max_consecutive: Optional[int] = None,  # abort after N in a row
+    anomaly_rollback: bool = False,  # restore last ckpt instead of aborting
+    step_deadline_s: Optional[float] = None,  # watchdog: stacks + exit 70
 ):
     """Train; returns (state, FitResult)."""
     import jax
@@ -355,6 +360,7 @@ def main(
         rules=rules, logical_axes=logical_axes,
         loss_fn=lm_loss, metrics_fn=lm_metrics,
         rng=jax.random.key(seed + 1), accum_steps=accum_steps,
+        skip_nonfinite=skip_nonfinite,
     )
     eval_step = build_eval_step(
         mesh, state, compute_dtype=dtype, rules=rules,
@@ -387,6 +393,9 @@ def main(
             profile_dir=profile_dir,
             metrics_path=metrics_path,
             checkpoint_every_steps=checkpoint_every_steps,
+            anomaly_max_consecutive=anomaly_max_consecutive,
+            anomaly_rollback=anomaly_rollback,
+            step_deadline_s=step_deadline_s,
         ),
     )
     return trainer.fit(state, train_iter, eval_factory)

@@ -47,7 +47,7 @@ class FakeRunner(CommandRunner):
         self.streams: List[Optional[str]] = []
 
     def run(self, argv, *, check=True, capture=True, env=None, timeout=None,
-            stream_to=None):
+            stream_to=None, retries=0):
         argv = [str(a) for a in argv]
         self.history.append(argv)
         self.envs.append(env)

@@ -97,6 +97,41 @@ def test_trainer_prefetch_matches_synchronous(mesh8):
     )
 
 
+def test_prefetch_close_reaps_worker_blocked_on_put(mesh8):
+    """Regression (ISSUE 2): close() must REAP the worker even when it sits
+    blocked in q.put — the old single get_nowait could unblock one put and
+    then leave the thread blocked forever on the next (e.g. the sentinel
+    going into a re-filled queue)."""
+    import itertools
+
+    def endless():
+        for i in itertools.count():
+            yield {"image": np.full((16, 4), i, np.float32)}
+
+    it = prefetch_to_device(endless(), mesh8, size=1)
+    next(it)  # worker now blocked in q.put with a full queue behind it
+    it.close()
+    assert not it.thread.is_alive()  # thread actually reaped, not leaked
+    with pytest.raises(RuntimeError, match="close"):
+        next(it)
+
+
+def test_prefetch_close_after_exhaustion_is_noop(mesh8):
+    it = prefetch_to_device(_host_batches(2), mesh8, size=2)
+    assert len(list(it)) == 2
+    it.close()
+    assert not it.thread.is_alive()
+
+
+def test_prefetch_close_reaps_worker_blocked_on_sentinel_put(mesh8):
+    """The exact leak shape from the issue: a finite source whose SENTINEL
+    put lands in a queue the consumer has stopped draining."""
+    it = prefetch_to_device(_host_batches(3), mesh8, size=1)
+    next(it)  # queue refills immediately; worker heads toward the sentinel
+    it.close()
+    assert not it.thread.is_alive()
+
+
 def test_prefetch_close_stops_worker_overconsumption(mesh8):
     """Closing the wrapper (Trainer.fit's finally) must stop the worker; it
     may stage at most the queue depth + 1 ahead of what was consumed."""

@@ -1,0 +1,691 @@
+"""The fault matrix: resilience layer + fault injection + retrying I/O.
+
+Covers (ISSUE 2 acceptance): nan-skip / abort-rollback, preempt →
+emergency-checkpoint → resume on the exact step, watchdog deadline +
+stack dump, retry backoff inside the jitter bounds, per-request serve
+fault isolation, and ``ddlt train --max-restarts`` surviving an injected
+preemption and a mid-epoch data-stream death.
+"""
+
+import itertools
+import logging
+import os
+import random
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+
+from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh
+from distributeddeeplearning_tpu.train import resilience
+from distributeddeeplearning_tpu.train.loop import Trainer, TrainerConfig
+from distributeddeeplearning_tpu.train.resilience import (
+    AnomalyDetector,
+    AnomalyError,
+    PreemptionError,
+    PreemptionGuard,
+    StepWatchdog,
+)
+from distributeddeeplearning_tpu.train.state import (
+    create_train_state,
+    sgd_momentum,
+)
+from distributeddeeplearning_tpu.train.step import build_train_step
+from distributeddeeplearning_tpu.utils import faults
+from distributeddeeplearning_tpu.utils.faults import (
+    DataStreamDeath,
+    FaultPlan,
+    InjectedIOError,
+    parse_spec,
+)
+from distributeddeeplearning_tpu.utils.retry import (
+    RateLimitedLogger,
+    backoff_delays,
+    retry_call,
+)
+
+GLOBAL_BATCH = 16
+IMG = (4, 4, 3)
+NCLS = 5
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan(monkeypatch):
+    """Every test starts and ends with an empty process fault plan."""
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _arm(monkeypatch, spec: str) -> FaultPlan:
+    monkeypatch.setenv(faults.ENV_VAR, spec)
+    return faults.reset()
+
+
+# --------------------------------------------------------------------------
+# fault spec grammar
+# --------------------------------------------------------------------------
+
+
+def test_fault_spec_grammar_roundtrip():
+    specs = parse_spec(
+        "nan_loss@12,data_stall@30:secs=2,preempt@50,io_error@p=0.05:seed=7"
+    )
+    assert [s.kind for s in specs] == [
+        "nan_loss", "data_stall", "preempt", "io_error"
+    ]
+    assert specs[0].step == 12 and specs[0].prob is None
+    assert specs[1].options == {"secs": 2}
+    assert specs[3].prob == 0.05 and specs[3].options["seed"] == 7
+    assert specs[1].describe() == "data_stall@30:secs=2"
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "explode@3",            # unknown kind
+        "nan_loss",             # missing trigger
+        "nan_loss@0",           # steps are 1-based
+        "io_error@p=1.5",       # probability outside [0, 1]
+        "data_stall@5:secs",    # option without value
+    ],
+)
+def test_fault_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+def test_step_keyed_faults_fire_once(monkeypatch):
+    plan = _arm(monkeypatch, "nan_loss@2")
+    batch = {"image": np.ones((4, 2), np.float32), "label": np.zeros(4, np.int32)}
+    assert not np.isnan(plan.poison_batch(1, batch)["image"]).any()
+    poisoned = plan.poison_batch(2, batch)
+    assert np.isnan(poisoned["image"]).all()
+    assert not np.isnan(poisoned["label"].astype(np.float64)).any()
+    # one-shot: step 2 again (after an in-process restart) does NOT re-fire
+    assert not np.isnan(plan.poison_batch(2, batch)["image"]).any()
+    assert [e.kind for e in plan.events] == ["nan_loss"]
+
+
+def test_nan_loss_on_float_free_batch_is_loud(monkeypatch):
+    plan = _arm(monkeypatch, "nan_loss@1")
+    with pytest.raises(ValueError, match="no float array"):
+        plan.poison_batch(1, {"input": np.zeros((2, 3), np.int32)})
+
+
+def test_io_error_fault_deterministic_by_seed(monkeypatch):
+    def firing_sequence():
+        plan = _arm(monkeypatch, "io_error@p=0.5:seed=7")
+        fired = []
+        for _ in range(20):
+            try:
+                plan.maybe_io_error("site")
+                fired.append(False)
+            except InjectedIOError:
+                fired.append(True)
+        return fired
+
+    first, second = firing_sequence(), firing_sequence()
+    assert first == second
+    assert any(first) and not all(first)
+
+
+def test_data_faults_wrap_iterator(monkeypatch):
+    plan = _arm(monkeypatch, "data_death@3")
+    stream = plan.wrap_data(iter([{"x": i} for i in range(5)]), start_step=0)
+    assert next(stream) == {"x": 0}
+    assert next(stream) == {"x": 1}
+    with pytest.raises(DataStreamDeath) as exc:
+        next(stream)
+    assert exc.value.step == 3
+
+
+# --------------------------------------------------------------------------
+# retry backoff
+# --------------------------------------------------------------------------
+
+
+def test_backoff_delays_stay_within_jitter_bounds():
+    base, cap = 0.1, 5.0
+    delays = list(
+        backoff_delays(12, base_delay=base, max_delay=cap, rng=random.Random(3))
+    )
+    assert len(delays) == 12
+    for i, d in enumerate(delays):
+        assert 0.0 <= d <= min(cap, base * 2**i)
+    # the later draws must actually use the grown window, not the first cap
+    assert max(delays) > base
+
+
+def test_retry_call_retries_then_succeeds():
+    calls, slept = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise IOError("transient")
+        return "ok"
+
+    assert retry_call(
+        flaky, retries=4, sleep=slept.append, rng=random.Random(0)
+    ) == "ok"
+    assert len(calls) == 3 and len(slept) == 2
+
+
+def test_retry_call_bounded_and_raises_last_error():
+    calls, slept = [], []
+
+    def always_fails():
+        calls.append(1)
+        raise IOError("still down")
+
+    with pytest.raises(IOError, match="still down"):
+        retry_call(
+            always_fails, retries=3, sleep=slept.append, rng=random.Random(0)
+        )
+    assert len(calls) == 4 and len(slept) == 3  # bounded: no infinite loop
+
+
+def test_rate_limited_logger_suppresses_within_interval():
+    clock = {"t": 0.0}
+    lines = []
+    rl = RateLimitedLogger(
+        lambda msg, *a: lines.append(msg % a if a else msg),
+        min_interval_s=60.0, clock=lambda: clock["t"],
+    )
+    assert rl("drop %d", 1)
+    for i in range(5):
+        clock["t"] += 1.0
+        assert not rl("drop %d", i)
+    clock["t"] += 60.0
+    assert rl("drop %d", 9)
+    assert len(lines) == 2 and "5 similar suppressed" in lines[1]
+
+
+def test_command_runner_retries_failing_command():
+    from distributeddeeplearning_tpu.control.command import CommandRunner
+
+    runner = CommandRunner()
+    slept = []
+    runner._sleep = slept.append
+    result = runner.run(
+        ["python", "-c", "import sys; sys.exit(3)"],
+        check=False, retries=2,
+    )
+    assert result.returncode == 3
+    assert len(runner.history) == 3 and len(slept) == 2
+    # success consumes no retries
+    runner2 = CommandRunner()
+    runner2._sleep = slept.append
+    assert runner2.run(["python", "-c", "pass"], retries=2).ok
+    assert len(runner2.history) == 1
+
+
+# --------------------------------------------------------------------------
+# MetricsLog drop path
+# --------------------------------------------------------------------------
+
+
+def test_metrics_log_drops_row_with_rate_limited_warning(
+    monkeypatch, tmp_path, caplog
+):
+    from distributeddeeplearning_tpu.train.loop import MetricsLog
+
+    _arm(monkeypatch, "io_error@p=1:seed=0")  # every write fails
+    log = MetricsLog(str(tmp_path / "metrics.jsonl"))
+    with caplog.at_level(logging.WARNING, logger="ddlt.train"):
+        log.append({"epoch": 1})
+        log.append({"epoch": 2})
+    assert log.dropped_rows == 2
+    assert not (tmp_path / "metrics.jsonl").exists()
+    drops = [r for r in caplog.records
+             if r.name == "ddlt.train" and "dropped" in r.getMessage()]
+    assert len(drops) == 1  # rate-limited: one line, not one per row
+
+
+def test_metrics_log_survives_transient_io_error(monkeypatch, tmp_path):
+    from distributeddeeplearning_tpu.train.loop import MetricsLog
+
+    # fail exactly the first write opportunity; the retry lands the row
+    _arm(monkeypatch, "io_error@1")
+    path = tmp_path / "metrics.jsonl"
+    log = MetricsLog(str(path))
+    log.append({"epoch": 1})
+    assert log.dropped_rows == 0
+    assert '"epoch": 1' in path.read_text()
+
+
+def test_checkpoint_save_retries_through_injected_io_error(
+    monkeypatch, tmp_path, tiny_parts
+):
+    from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
+
+    _, mk_state, _ = tiny_parts
+    plan = _arm(monkeypatch, "io_error@1")
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    assert ckpt.save(1, mk_state()) is True  # retried past the injection
+    ckpt.wait()
+    assert ckpt.latest_step() == 1
+    assert [e.kind for e in plan.events] == ["io_error"]
+
+
+# --------------------------------------------------------------------------
+# trainer-level fault matrix (tiny dense model: compile stays cheap)
+# --------------------------------------------------------------------------
+
+
+class _Tiny(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        return nn.Dense(NCLS)(x.reshape((x.shape[0], -1)))
+
+
+@pytest.fixture(scope="module")
+def tiny_parts():
+    mesh = create_mesh(MeshSpec())
+    model = _Tiny()
+    tx = sgd_momentum(optax.constant_schedule(0.05))
+
+    def mk_state():
+        return create_train_state(jax.random.key(0), model, (8, *IMG), tx)
+
+    guarded_step = build_train_step(
+        mesh, mk_state(), compute_dtype=jnp.float32, skip_nonfinite=True
+    )
+    return mesh, mk_state, guarded_step
+
+
+def _factory(start_step: int):
+    """Step-indexed deterministic stream (exact-resume contract)."""
+
+    def gen():
+        i = start_step
+        while True:
+            rng = np.random.default_rng(1000 + i)
+            yield {
+                "image": rng.standard_normal(
+                    (GLOBAL_BATCH, *IMG)
+                ).astype(np.float32),
+                "label": rng.integers(0, NCLS, (GLOBAL_BATCH,)).astype(
+                    np.int32
+                ),
+            }
+            i += 1
+
+    return gen()
+
+
+def _flat(state):
+    import jax.flatten_util
+
+    leaves, _ = jax.flatten_util.ravel_pytree(
+        {"p": state.params, "o": state.opt_state}
+    )
+    return np.asarray(leaves)
+
+
+def test_nan_loss_step_is_skipped_not_applied(monkeypatch, tiny_parts):
+    """The poisoned step's update must be discarded on device (the
+    skip_nonfinite guard), counted by the detector, excluded from the epoch
+    metrics — and every parameter must stay finite."""
+    mesh, mk_state, step = tiny_parts
+    cfg = TrainerConfig(
+        epochs=2, steps_per_epoch=3, global_batch_size=GLOBAL_BATCH,
+        prefetch=0, anomaly_max_consecutive=3,
+    )
+    _arm(monkeypatch, "nan_loss@4")
+    state, fit = Trainer(mesh, step, config=cfg).fit(mk_state(), _factory)
+    assert fit.anomalous_steps == 1
+    assert int(state.step) == 6  # step advances even when skipped
+    assert np.isfinite(_flat(state)).all()
+    # epoch 2 contains the anomalous step 4: its loss mean excludes the NaN
+    # and the row carries the anomaly count
+    assert np.isfinite(fit.final_train_metrics["loss"])
+    assert fit.final_train_metrics["anomalous_steps"] == 1.0
+
+
+def test_anomaly_abort_after_consecutive(monkeypatch, tiny_parts):
+    mesh, mk_state, step = tiny_parts
+    cfg = TrainerConfig(
+        epochs=2, steps_per_epoch=3, global_batch_size=GLOBAL_BATCH,
+        prefetch=0, anomaly_max_consecutive=2,
+    )
+    _arm(monkeypatch, "nan_loss@2,nan_loss@3")
+    with pytest.raises(AnomalyError) as exc:
+        Trainer(mesh, step, config=cfg).fit(mk_state(), _factory)
+    assert exc.value.consecutive == 2 and exc.value.step == 3
+
+
+def test_anomaly_abort_rolls_back_to_checkpoint(
+    monkeypatch, tiny_parts, tmp_path
+):
+    """abort-rollback: after N consecutive anomalies the Trainer restores
+    the last checkpoint and finishes (the injected faults are one-shot)."""
+    mesh, mk_state, step = tiny_parts
+    cfg = TrainerConfig(
+        epochs=2, steps_per_epoch=3, global_batch_size=GLOBAL_BATCH,
+        prefetch=0, anomaly_max_consecutive=2, anomaly_rollback=True,
+        checkpoint_dir=str(tmp_path / "rb"), checkpoint_every_steps=2,
+    )
+    _arm(monkeypatch, "nan_loss@3,nan_loss@4")
+    state, fit = Trainer(mesh, step, config=cfg).fit(mk_state(), _factory)
+    assert fit.rollbacks == 1
+    assert int(state.step) == 6
+    assert np.isfinite(_flat(state)).all()
+
+
+def test_preempt_emergency_checkpoint_then_exact_resume(
+    monkeypatch, tiny_parts, tmp_path
+):
+    """preempt → synchronous emergency checkpoint at the preempted step →
+    resume lands on that exact step → final state bit-identical to an
+    uninterrupted run."""
+    mesh, mk_state, step = tiny_parts
+    base = dict(
+        epochs=2, steps_per_epoch=4, global_batch_size=GLOBAL_BATCH,
+        prefetch=0,
+    )
+    ref_state, _ = Trainer(
+        mesh, step, config=TrainerConfig(**base)
+    ).fit(mk_state(), _factory)
+
+    ckpt = str(tmp_path / "pe")
+    cfg = TrainerConfig(checkpoint_dir=ckpt, **base)
+    _arm(monkeypatch, "preempt@5")
+    trainer = Trainer(mesh, step, config=cfg)
+    with pytest.raises(PreemptionError) as exc:
+        trainer.fit(mk_state(), _factory)
+    assert exc.value.step == 5
+    # the emergency checkpoint landed SYNCHRONOUSLY at the preempted step
+    assert trainer.checkpointer.latest_step() == 5
+
+    resumed, fit = Trainer(mesh, step, config=cfg).fit(mk_state(), _factory)
+    assert int(resumed.step) == 8
+    assert fit.total_images == 3 * GLOBAL_BATCH  # only steps 6..8 re-ran
+    np.testing.assert_array_equal(_flat(resumed), _flat(ref_state))
+
+
+def test_sigterm_triggers_guard_and_restores_handler():
+    guard = PreemptionGuard(signals=(signal.SIGTERM,))
+    prev = signal.getsignal(signal.SIGTERM)
+    with guard:
+        assert not guard.preempted()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.preempted()
+        assert "SIGTERM" in guard.reason
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_anomaly_detector_tolerates_isolated_blips():
+    det = AnomalyDetector(max_consecutive=2)
+    assert det.observe(1, float("nan"))
+    assert not det.observe(2, 0.5)          # resets the consecutive count
+    assert det.observe(3, 1.0, float("inf"))  # grad-norm anomaly counts too
+    assert not det.observe(4, 0.5)
+    assert det.total == 2
+    det.observe(5, float("nan"))
+    with pytest.raises(AnomalyError):
+        det.observe(6, float("nan"))
+
+
+# --------------------------------------------------------------------------
+# watchdog
+# --------------------------------------------------------------------------
+
+
+def test_watchdog_fires_and_dumps_stacks():
+    import io
+    import time
+
+    buf = io.StringIO()
+    fired = []
+    wd = StepWatchdog(
+        0.2, on_timeout=lambda: fired.append(1), poll_s=0.02, stream=buf
+    )
+    with wd:
+        wd.tick()
+        deadline = time.monotonic() + 5.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.02)
+    assert fired and wd.fired
+    out = buf.getvalue()
+    assert "watchdog" in out
+    # the all-thread stack dump names at least this (the main) thread
+    assert "Thread" in out or "thread" in out
+
+
+def test_watchdog_quiet_while_ticking():
+    import time
+
+    fired = []
+    wd = StepWatchdog(0.3, on_timeout=lambda: fired.append(1), poll_s=0.02)
+    with wd:
+        for _ in range(10):
+            wd.tick()
+            time.sleep(0.05)
+        wd.pause()
+        time.sleep(0.5)  # paused: an idle gap must not fire
+    assert not fired
+
+
+def test_watchdog_unarmed_until_first_tick():
+    import time
+
+    fired = []
+    wd = StepWatchdog(0.1, on_timeout=lambda: fired.append(1), poll_s=0.02)
+    with wd:
+        time.sleep(0.4)  # compile-phase analogue: no tick yet
+    assert not fired
+
+
+# --------------------------------------------------------------------------
+# supervisor
+# --------------------------------------------------------------------------
+
+
+def test_supervise_restart_budget():
+    calls = []
+
+    def fn(attempt):
+        calls.append(attempt)
+        if len(calls) < 3:
+            raise resilience.RestartableError("again", step=len(calls))
+        return "done"
+
+    result, restarts = resilience.supervise(fn, max_restarts=2)
+    assert result == "done" and restarts == 2 and calls == [0, 1, 2]
+
+    calls.clear()
+    with pytest.raises(resilience.RestartableError):
+        resilience.supervise(fn, max_restarts=1)
+
+
+def test_control_plane_exit_code_matches_resilience_contract():
+    """control/submit.py declares the resumable exit code as a literal (to
+    keep the control plane jax-free); it must stay equal to the runner's."""
+    from distributeddeeplearning_tpu.control import submit
+
+    assert submit.RESUMABLE_EXIT_CODE == resilience.RESUMABLE_EXIT_CODE
+
+
+def test_runner_exits_resumable_code_on_preemption():
+    from distributeddeeplearning_tpu.workloads._runner import run_from_argv
+
+    def main(*, epochs: int = 1):
+        raise PreemptionError("preempted at step 3", step=3)
+
+    with pytest.raises(SystemExit) as exc:
+        run_from_argv(main, ["--epochs", "2"])
+    assert exc.value.code == resilience.RESUMABLE_EXIT_CODE
+
+
+# --------------------------------------------------------------------------
+# serve scheduler fault isolation
+# --------------------------------------------------------------------------
+
+
+class _FakeEngine:
+    batch_slots = 2
+    max_seq = 32
+
+    def prefill(self, slot, prompt):
+        if len(prompt) == 13:
+            raise RuntimeError("bad prompt blew up the kernel")
+        return 1
+
+    def decode(self, tokens, pos):
+        return np.full(self.batch_slots, 2, np.int32)
+
+
+def test_scheduler_isolates_per_request_prefill_failure():
+    from distributeddeeplearning_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+
+    sched = ContinuousBatchingScheduler(_FakeEngine(), max_new_tokens=3)
+    results, report = sched.run([
+        Request("ok1", [1, 2, 3]),
+        Request("bad", list(range(13))),
+        Request("ok2", [4, 5]),
+    ])
+    by_uid = {r.uid: r for r in results}
+    assert by_uid["bad"].finish_reason == "error"
+    assert "blew up" in by_uid["bad"].error
+    assert by_uid["ok1"].finish_reason == "length"
+    assert by_uid["ok2"].finish_reason == "length"
+    assert len(by_uid["ok1"].tokens) == 3  # unaffected by the bad request
+    assert report.errors == 1
+    assert report.finish_reasons == {"error": 1, "length": 2}
+    assert report.to_dict()["errors"] == 1  # surfaced in the artifact schema
+
+
+def test_scheduler_survives_decode_failure_and_drains_queue():
+    class _FlakyDecode(_FakeEngine):
+        def __init__(self):
+            self.calls = 0
+
+        def prefill(self, slot, prompt):
+            return 1
+
+        def decode(self, tokens, pos):
+            self.calls += 1
+            if self.calls == 1:
+                raise RuntimeError("collective died")
+            return np.full(self.batch_slots, 2, np.int32)
+
+    from distributeddeeplearning_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+
+    sched = ContinuousBatchingScheduler(_FlakyDecode(), max_new_tokens=2)
+    results, report = sched.run(
+        [Request("x", [1]), Request("y", [2]), Request("z", [3])]
+    )
+    reasons = {r.uid: r.finish_reason for r in results}
+    assert report.errors == 2          # the two slots active at the failure
+    assert reasons["z"] == "length"    # queued request still served
+
+
+# --------------------------------------------------------------------------
+# ddlt train --max-restarts (the CLI supervisor, end to end on CPU)
+# --------------------------------------------------------------------------
+
+
+def test_cli_train_survives_nan_and_preemption_exactly(
+    monkeypatch, tmp_path, capsys
+):
+    """ISSUE 2 acceptance: DDLT_FAULTS="nan_loss@12,preempt@50" — the run
+    skips the anomalous step, emergency-checkpoints at the simulated
+    preemption, and ``ddlt train --max-restarts 1`` resumes to finish with
+    the exact configured step count (3 epochs x 20 = 60)."""
+    from distributeddeeplearning_tpu.cli.main import main as cli_main
+    from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
+
+    ckpt = str(tmp_path / "ck")
+    monkeypatch.setenv(faults.ENV_VAR, "nan_loss@12,preempt@50")
+    rc = cli_main([
+        "train", "imagenet", "--max-restarts", "1",
+        "--model", "resnet18", "--image_size", "16", "--batch_size", "1",
+        "--num_classes", "3", "--epochs", "3", "--steps_per_epoch", "20",
+        "--train_images", "480", "--compute_dtype", "float32",
+        "--skip_nonfinite", "true", "--anomaly_max_consecutive", "5",
+        "--save_filepath", ckpt,
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "restarts=1" in out and "completed at step 60" in out
+    ck = Checkpointer(ckpt)
+    try:
+        steps = set(ck._mgr.all_steps())
+    finally:
+        ck.close()
+    assert 50 in steps   # the emergency checkpoint at the preempted step
+    assert 60 in steps   # ...and the resumed run finished exactly
+    plan = faults.get_plan()
+    assert {e.kind for e in plan.events} == {"nan_loss", "preempt"}
+
+
+def test_cli_train_survives_mid_epoch_data_stream_death(
+    monkeypatch, tmp_path, capsys
+):
+    """A data stream that dies mid-epoch is restartable: the supervisor
+    re-enters the workload, which resumes from the last periodic
+    checkpoint and completes the configured step count."""
+    from distributeddeeplearning_tpu.cli.main import main as cli_main
+    from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
+
+    ckpt = str(tmp_path / "ck")
+    monkeypatch.setenv(faults.ENV_VAR, "data_death@6")
+    rc = cli_main([
+        "train", "transformer", "--max-restarts", "1",
+        "--num_layers", "2", "--d_model", "32", "--num_heads", "2",
+        "--d_ff", "64", "--vocab_size", "64", "--seq_len", "16",
+        "--batch_size", "1", "--epochs", "2", "--steps_per_epoch", "4",
+        "--compute_dtype", "float32", "--checkpoint_every_steps", "2",
+        "--save_filepath", ckpt,
+    ])
+    assert rc == 0
+    assert "restarts=1" in capsys.readouterr().out
+    ck = Checkpointer(ckpt)
+    try:
+        assert ck.latest_step() == 8
+    finally:
+        ck.close()
+
+
+def test_cli_train_exhausted_preemption_budget_exits_resumable(
+    monkeypatch, tmp_path
+):
+    """With no restart budget a preemption exits RESUMABLE_EXIT_CODE (75):
+    the handoff contract to an OUTER supervisor."""
+    from distributeddeeplearning_tpu.cli.main import main as cli_main
+
+    monkeypatch.setenv(faults.ENV_VAR, "preempt@2")
+    rc = cli_main([
+        "train", "transformer", "--max-restarts", "0",
+        "--num_layers", "2", "--d_model", "32", "--num_heads", "2",
+        "--d_ff", "64", "--vocab_size", "64", "--seq_len", "16",
+        "--batch_size", "1", "--epochs", "1", "--steps_per_epoch", "3",
+        "--compute_dtype", "float32",
+        "--save_filepath", str(tmp_path / "ck"),
+    ])
+    assert rc == resilience.RESUMABLE_EXIT_CODE
+
+
+def test_cli_train_dry_run_and_flag_passthrough(capsys):
+    from distributeddeeplearning_tpu.cli.main import main as cli_main
+
+    rc = cli_main([
+        "train", "imagenet", "--max-restarts", "2", "--dry-run",
+        "--epochs", "1", "--model", "resnet18",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "supervise imagenet" in out and "max_restarts=2" in out
