@@ -677,88 +677,67 @@ def _run_roofline(args) -> int:
     return 0
 
 
-def _run_serve(args) -> int:
-    """Serving benchmark: the KV-cached engine under continuous batching.
+def _serve_warmup(engine, max_seq, requests, *, vocab_size) -> None:
+    """Compile EVERY prefill shape the request set will hit plus the
+    decode step, so the timed run measures serving, not XLA.
 
-    Builds the causal LM at the same dims as ``--model lm`` (``--small``
-    shrinks it), admits ``--serve-requests`` synthetic prompts (more than
-    ``--batch-slots``, so slot release/reuse is exercised) and emits ONE
-    JSON line — the ``SERVE_*.json`` artifact: generated tokens/s, TTFT
-    p50/p99, per-decode-step latency, mean slot occupancy, platform +
-    virtual_pod provenance.
+    Dense: one prompt per distinct power-of-two prompt bucket.  Paged:
+    one prompt per possible chunk shape (full chunk + the power-of-two
+    final-chunk buckets), each with DISTINCT token values so warmup
+    prompts cannot prefix-hit each other and skip a shape.  Budget THREE
+    tokens: the first comes from prefill at admission (a 1-token budget
+    never decodes at all), and the donated-cache decode needs TWO steps
+    to reach steady state — the first call compiles, the second
+    recompiles with the output layouts fed back as input layouts (the
+    layout-donation double compile, same as the train step).
+
+    After warmup the engine's run counters (and, for paged, the prefix
+    table the warmup prompts seeded) are reset, so the benchmarked phase
+    reports ``prefill_compiles == 0`` and an honest prefix-hit rate.
     """
-    import jax
-    import numpy as np
-
-    from distributeddeeplearning_tpu.models.pipelined_transformer import (
-        init_params,
-    )
     from distributeddeeplearning_tpu.serve import (
         ContinuousBatchingScheduler,
         Request,
-        cache_bytes,
-        data_parallel_engine,
-        synthetic_requests,
     )
-
-    dims = dict(num_layers=12, d_model=768, num_heads=12, d_ff=3072,
-                vocab_size=32768)
-    if args.small:
-        dims = dict(num_layers=2, d_model=64, num_heads=4, d_ff=128,
-                    vocab_size=257)
-    max_prompt = max(8, args.seq_len)
-    max_seq = max_prompt + args.max_new_tokens
-    params = init_params(jax.random.key(0), max_len=max_seq, **dims)
-
-    n_dev = len(jax.devices())
-    engine, mesh = data_parallel_engine(
-        params,
-        num_heads=dims["num_heads"],
-        batch_slots=args.batch_slots,
-        max_seq=max_seq,
-        prefill_attention="flash" if args.attention == "flash" else "dense",
-        temperature=args.serve_temperature,
-        rng=jax.random.key(1),
-    )
-    requests = synthetic_requests(
-        args.serve_requests, vocab_size=dims["vocab_size"],
-        max_prompt=max_prompt, min_prompt=max_prompt // 2,
-        rng=np.random.default_rng(0),
-    )
-    scheduler = ContinuousBatchingScheduler(
-        engine, max_new_tokens=args.max_new_tokens
-    )
-    # warmup: compile EVERY prefill bucket the request set will hit plus
-    # the decode step, so the timed run measures serving, not XLA — one
-    # prompt per distinct bucket (lengths span two power-of-two buckets
-    # in the default config).  Budget THREE tokens: the first comes from
-    # prefill at admission (a 1-token budget never decodes at all), and
-    # the donated-cache decode needs TWO steps to reach steady state —
-    # the first call compiles, the second recompiles with the output
-    # layouts fed back as input layouts (the layout-donation double
-    # compile, same as the train step).
     from distributeddeeplearning_tpu.serve.engine import prompt_bucket
 
-    buckets = {}
-    for r in requests:
-        buckets.setdefault(prompt_bucket(len(r.prompt), max_seq), r.prompt)
+    if getattr(engine, "chunked_prefill", False):
+        C = engine.prefill_chunk
+        shapes, b = {C}, 8
+        while b < C:
+            shapes.add(b)
+            b *= 2
+        warm = [
+            Request(uid=f"warmup{i}", prompt=[(i % (vocab_size - 1)) + 1] * s)
+            for i, s in enumerate(sorted(shapes))
+            if s < engine.max_seq
+        ]
+    else:
+        buckets = {}
+        for r in requests:
+            buckets.setdefault(prompt_bucket(len(r.prompt), max_seq), r.prompt)
+        warm = [
+            Request(uid=f"warmup{i}", prompt=p)
+            for i, p in enumerate(buckets.values())
+        ]
     _, warm_report = ContinuousBatchingScheduler(
         engine, max_new_tokens=3
-    ).run([
-        Request(uid=f"warmup{i}", prompt=p)
-        for i, p in enumerate(buckets.values())
-    ])
+    ).run(warm)
     assert warm_report.decode_steps >= 2, "warmup never reached decode"
-    results, report = scheduler.run(requests)
+    if hasattr(engine, "reset_stats"):
+        engine.reset_stats()
+    if hasattr(engine, "clear_prefix_cache"):
+        engine.clear_prefix_cache()
+    engine.prefill_compiles = 0
 
-    # One schema with ddlt serve's --report (ServeReport.to_dict(), the
-    # README-documented keys) plus the bench-line headline fields and
-    # ms-denominated conveniences.
-    line = {
-        "metric": f"lm_serve_{args.attention}_tok_sec",
-        "value": report.tokens_per_sec,
-        "unit": "tok/sec",
-        "vs_baseline": None,
+
+def _serve_line(report, engine, args, *, max_prompt, mesh=None):
+    """One engine run -> the SERVE artifact dict (ServeReport.to_dict(),
+    the README-documented keys, plus headline + ms conveniences)."""
+    import jax
+
+    admitted = report.prompt_tokens + report.generated_tokens
+    return {
         **report.to_dict(),
         "ttft_ms": {
             "p50": round(report.ttft_s["p50"] * 1e3, 2),
@@ -770,12 +749,207 @@ def _run_serve(args) -> int:
         },
         "max_new_tokens": args.max_new_tokens,
         "max_prompt_len": max_prompt,
-        "kv_cache_mb": round(cache_bytes(engine.cache) / 1e6, 3),
-        "mesh_devices": n_dev if mesh is not None else 1,
+        "kv_cache_mb": round(engine.kv_bytes() / 1e6, 3),
+        "hbm_bytes_per_admitted_token": (
+            round(report.kv_bytes_peak / admitted, 2) if admitted else None
+        ),
+        "mesh_devices": (
+            len(jax.devices()) if mesh is not None else 1
+        ),
         "platform": jax.default_backend(),
         "virtual_pod": _is_virtual_pod(),
     }
+
+
+def _run_serve(args) -> int:
+    """Serving benchmark: the KV-cached engine under continuous batching.
+
+    Builds the causal LM at the same dims as ``--model lm`` (``--small``
+    shrinks it), admits ``--serve-requests`` synthetic prompts (more than
+    ``--batch-slots``, so slot release/reuse is exercised) and emits ONE
+    JSON line — the ``SERVE_*.json`` artifact: generated tokens/s, TTFT
+    p50/p99, queue wait, per-decode-step latency, mean slot occupancy,
+    platform + virtual_pod provenance.
+
+    ``--kv-layout`` selects the cache layout: ``dense`` (per-slot
+    ``max_seq`` reservation), ``paged`` (page pool + block tables +
+    chunked prefill), or ``both`` — the paged-vs-dense comparison
+    (``SERVE_PAGED_*.json``): identical mixed-length greedy traffic
+    through both layouts (generated tokens asserted bit-identical), HBM
+    bytes per admitted token for each, plus a shared-prefix workload for
+    the prefix-cache hit rate.  In ``both`` mode ``max_seq`` is
+    provisioned with headroom (4x the longest request) the way a server
+    sizes its context window — the dense layout must reserve it per slot,
+    the paged layout commits pages only for actual tokens, which is the
+    entire comparison.
+    """
+    import jax
+    import numpy as np
+
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        init_params,
+    )
+    from distributeddeeplearning_tpu.serve import (
+        ContinuousBatchingScheduler,
+        PagedInferenceEngine,
+        data_parallel_engine,
+        synthetic_requests,
+    )
+
+    dims = dict(num_layers=12, d_model=768, num_heads=12, d_ff=3072,
+                vocab_size=32768)
+    if args.small:
+        dims = dict(num_layers=2, d_model=64, num_heads=4, d_ff=128,
+                    vocab_size=257)
+    compare = args.kv_layout == "both"
+    max_prompt = max(8, args.seq_len)
+    if compare:
+        # provisioning headroom: a server sizes max_seq for the longest
+        # ADMISSIBLE request, not the longest observed — dense pays it
+        # per slot, paged pays per actual token
+        max_seq = 4 * (max_prompt + args.max_new_tokens)
+    else:
+        max_seq = max_prompt + args.max_new_tokens
+    params = init_params(jax.random.key(0), max_len=max_seq, **dims)
+
+    def build(layout):
+        if layout == "paged":
+            return PagedInferenceEngine(
+                params,
+                num_heads=dims["num_heads"],
+                batch_slots=args.batch_slots,
+                max_seq=max_seq,
+                page_size=args.page_size,
+                num_pages=args.kv_pages,
+                prefill_chunk=args.prefill_chunk,
+                temperature=args.serve_temperature,
+                rng=jax.random.key(1),
+            ), None
+        return data_parallel_engine(
+            params,
+            num_heads=dims["num_heads"],
+            batch_slots=args.batch_slots,
+            max_seq=max_seq,
+            prefill_attention=(
+                "flash" if args.attention == "flash" else "dense"
+            ),
+            temperature=args.serve_temperature,
+            rng=jax.random.key(1),
+        )
+
+    def run_one(engine, requests):
+        # smoke mode (--steps-cap) skips warmup: the point is a fast
+        # scheduler/allocator exercise, not clean timings
+        if args.steps_cap is None:
+            _serve_warmup(
+                engine, max_seq, requests, vocab_size=dims["vocab_size"]
+            )
+        results, report = ContinuousBatchingScheduler(
+            engine,
+            max_new_tokens=args.max_new_tokens,
+            step_cap=args.steps_cap,
+        ).run(list(requests))
+        if args.steps_cap is None:
+            assert report.prefill_compiles == 0, (
+                f"warmup missed {report.prefill_compiles} prefill "
+                "shape(s) — the timed phase hit mid-run compiles"
+            )
+        return results, report
+
+    if not compare:
+        engine, mesh = build(args.kv_layout)
+        requests = synthetic_requests(
+            args.serve_requests, vocab_size=dims["vocab_size"],
+            max_prompt=max_prompt, min_prompt=max_prompt // 2,
+            rng=np.random.default_rng(0),
+        )
+        results, report = run_one(engine, requests)
+        line = {
+            "metric": f"lm_serve_{args.attention}_tok_sec",
+            "value": report.tokens_per_sec,
+            "unit": "tok/sec",
+            "vs_baseline": None,
+            **_serve_line(report, engine, args,
+                          max_prompt=max_prompt, mesh=mesh),
+        }
+    else:
+        # ---- paged vs dense: identical mixed-length greedy traffic ----
+        mixed = synthetic_requests(
+            args.serve_requests, vocab_size=dims["vocab_size"],
+            max_prompt=max_prompt, min_prompt=max(2, max_prompt // 8),
+            rng=np.random.default_rng(0),
+        )
+        dense_engine, mesh = build("dense")
+        dense_res, dense_rep = run_one(dense_engine, mixed)
+        paged_engine, _ = build("paged")
+        paged_res, paged_rep = run_one(paged_engine, mixed)
+        # the gate compares dense-math prefill on both sides: the Pallas
+        # flash kernel's online-softmax reduction order differs in ulps
+        # from the paged chunk program's dense math, so a near-tie argmax
+        # could flip a token without either layout being wrong
+        bit_exact_gate = (
+            args.serve_temperature <= 0
+            and args.steps_cap is None
+            and args.attention != "flash"
+        )
+        if bit_exact_gate:
+            d = {r.uid: r.tokens for r in dense_res}
+            p = {r.uid: r.tokens for r in paged_res}
+            assert d == p, (
+                "paged decode diverged from dense on identical greedy "
+                "traffic — the layouts are no longer bit-exact"
+            )
+        # ---- shared-prefix workload: the prefix-cache column ----
+        shared = synthetic_requests(
+            args.serve_requests, vocab_size=dims["vocab_size"],
+            max_prompt=max(2, max_prompt // 2),
+            min_prompt=2,
+            shared_prefix_len=max_prompt // 2,
+            rng=np.random.default_rng(1),
+        )
+        _, shared_rep = run_one(paged_engine, shared)
+        d_line = _serve_line(dense_rep, dense_engine, args,
+                             max_prompt=max_prompt, mesh=mesh)
+        p_line = _serve_line(paged_rep, paged_engine, args,
+                             max_prompt=max_prompt)
+        ratio = (
+            round(
+                d_line["hbm_bytes_per_admitted_token"]
+                / p_line["hbm_bytes_per_admitted_token"], 2,
+            )
+            if p_line["hbm_bytes_per_admitted_token"]
+            else None
+        )
+        line = {
+            "metric": "lm_serve_paged_vs_dense_hbm_ratio",
+            # admitted-tokens-per-HBM-byte improvement of paged over dense
+            "value": ratio,
+            "unit": "x",
+            "vs_baseline": None,
+            "bit_exact_vs_dense": bit_exact_gate,
+            "max_seq_provisioned": max_seq,
+            "page_size": args.page_size,
+            "prefill_chunk": args.prefill_chunk,
+            "tokens_per_sec": {
+                "dense": dense_rep.tokens_per_sec,
+                "paged": paged_rep.tokens_per_sec,
+            },
+            "prefix_hit_rate_shared_workload": shared_rep.prefix_hit_rate,
+            "dense": d_line,
+            "paged": p_line,
+            "paged_shared_prefix": {
+                "prefix_hit_rate": shared_rep.prefix_hit_rate,
+                "tokens_per_sec": shared_rep.tokens_per_sec,
+                "ttft_s": shared_rep.ttft_s,
+            },
+            "platform": jax.default_backend(),
+            "virtual_pod": _is_virtual_pod(),
+        }
     print(json.dumps(line))
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(line, f, indent=2)
+            f.write("\n")
     return 0
 
 
@@ -1164,6 +1338,44 @@ def main() -> int:
         type=float,
         default=0.0,
         help="sampling temperature for --serve (0 = greedy)",
+    )
+    parser.add_argument(
+        "--kv-layout",
+        default="dense",
+        choices=("dense", "paged", "both"),
+        help="KV-cache layout for --serve: dense (per-slot max_seq "
+        "reservation), paged (page pool + block tables + chunked "
+        "prefill), or both — the paged-vs-dense comparison artifact "
+        "(SERVE_PAGED_*.json: bit-exactness gate, HBM bytes per admitted "
+        "token, prefix-hit rate on a shared-prefix workload)",
+    )
+    parser.add_argument(
+        "--page-size",
+        type=int,
+        default=16,
+        help="tokens per KV page for --kv-layout paged/both",
+    )
+    parser.add_argument(
+        "--prefill-chunk",
+        type=int,
+        default=32,
+        help="prompt tokens prefilled per interleaved chunk "
+        "(--kv-layout paged/both)",
+    )
+    parser.add_argument(
+        "--kv-pages",
+        type=int,
+        default=None,
+        help="page-pool size for --kv-layout paged (default: dense-"
+        "capacity parity, batch_slots x ceil(max_seq/page_size))",
+    )
+    parser.add_argument(
+        "--steps-cap",
+        type=int,
+        default=None,
+        help="hard decode-step budget for --serve smoke runs: warmup is "
+        "skipped, active requests complete as 'step_cap', queued ones as "
+        "'cancelled' — a scheduler/allocator regression can never hang CI",
     )
     parser.add_argument(
         "--faults",

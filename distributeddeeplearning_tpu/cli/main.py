@@ -344,7 +344,29 @@ def build_parser() -> argparse.ArgumentParser:
     serve_p.add_argument("--prefill-attention", default="flash",
                          choices=("flash", "dense"),
                          help="prompt-pass attention (decode is always "
-                         "dense against the cache)")
+                         "dense against the cache; paged layout prefills "
+                         "through its chunk program instead)")
+    serve_p.add_argument("--kv-layout", default="dense",
+                         choices=("dense", "paged"),
+                         help="KV-cache layout: dense reserves max_seq per "
+                         "slot; paged allocates fixed-size pages by actual "
+                         "tokens, shares identical prompt-prefix pages, "
+                         "and prefills long prompts in chunks interleaved "
+                         "with decode steps")
+    serve_p.add_argument("--page-size", type=int, default=64,
+                         help="tokens per KV page (--kv-layout paged)")
+    serve_p.add_argument("--kv-pages", type=int, default=None,
+                         help="page-pool size (--kv-layout paged; default: "
+                         "dense-capacity parity, batch_slots x "
+                         "ceil(max_seq/page_size) — set LOWER to trade "
+                         "admission concurrency for HBM)")
+    serve_p.add_argument("--prefill-chunk", type=int, default=64,
+                         help="prompt tokens prefilled per interleaved "
+                         "chunk (--kv-layout paged): caps how long one "
+                         "admission can stall in-flight decode steps")
+    serve_p.add_argument("--no-prefix-cache", action="store_true",
+                         help="disable shared-prefix page reuse "
+                         "(--kv-layout paged)")
     serve_p.add_argument("--report", default=None,
                          help="also write the stats JSON here "
                          "(e.g. SERVE_r06.json)")
@@ -1127,16 +1149,40 @@ def _cmd_serve(args) -> int:
         return 1
 
     n_dev = len(jax.devices())
-    engine, mesh = data_parallel_engine(
-        params,
-        num_heads=num_heads,
-        batch_slots=args.batch_slots,
-        max_seq=max_seq,
-        prefill_attention=args.prefill_attention,
-        temperature=args.temperature,
-        top_k=args.top_k,
-        rng=jax.random.key(args.seed),
-    )
+    if args.kv_layout == "paged":
+        from distributeddeeplearning_tpu.serve import PagedInferenceEngine
+
+        if args.page_size < 1 or args.prefill_chunk < 1:
+            print("--page-size and --prefill-chunk must be >= 1",
+                  file=sys.stderr)
+            return 1
+        # single-mesh: the block-table gather crosses the page axis, so
+        # the paged pool does not shard over devices (the dense layout
+        # remains the multi-chip path)
+        engine, mesh = PagedInferenceEngine(
+            params,
+            num_heads=num_heads,
+            batch_slots=args.batch_slots,
+            max_seq=max_seq,
+            page_size=args.page_size,
+            num_pages=args.kv_pages,
+            prefill_chunk=args.prefill_chunk,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            rng=jax.random.key(args.seed),
+            prefix_cache=not args.no_prefix_cache,
+        ), None
+    else:
+        engine, mesh = data_parallel_engine(
+            params,
+            num_heads=num_heads,
+            batch_slots=args.batch_slots,
+            max_seq=max_seq,
+            prefill_attention=args.prefill_attention,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            rng=jax.random.key(args.seed),
+        )
     scheduler = ContinuousBatchingScheduler(
         engine, eos_id=args.eos_id, max_new_tokens=args.max_new_tokens
     )

@@ -338,6 +338,184 @@ def forward_decode(params, token, cache, pos, *, num_heads: int):
     return x @ params["head"], new_cache
 
 
+def _block_decode_paged(
+    p, x, k_l, v_l, pos, block_tables, *, num_heads: int, page_size: int
+):
+    """One block's single-token decode against a PAGED cache layer.
+
+    ``k_l``/``v_l``: [pages, page_size, h, hd] — this layer's slice of the
+    global page pool; ``block_tables``: [B, nb] int32 mapping each slot's
+    logical page index to a physical page (logical position ``j`` lives at
+    ``(table[j // page_size], j % page_size)``).  Same write-then-attend
+    order as :func:`_block_decode`: the new token's K/V scatter to
+    ``(table[pos // ps], pos % ps)``, then attention runs over the slot's
+    gathered pages with positions ``<= pos`` visible.  Released slots
+    point every table entry at the scratch page and sit at pos 0, so their
+    writes land in the dustbin and never touch a live page.
+    """
+    b, d = x.shape
+    nb = block_tables.shape[1]
+    s = nb * page_size
+    hd = d // num_heads
+
+    h = _layer_norm(x, p["ln1"])
+    qkv = h @ p["qkv"]  # [b, 3d]
+    q, k_t, v_t = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, num_heads, hd)
+    rows = jnp.arange(b)
+    page = block_tables[rows, pos // page_size]  # [b] physical page
+    off = pos % page_size
+    k_l = k_l.at[page, off].set(
+        k_t.reshape(b, num_heads, hd).astype(k_l.dtype)
+    )
+    v_l = v_l.at[page, off].set(
+        v_t.reshape(b, num_heads, hd).astype(v_l.dtype)
+    )
+    # block-table gather: [b, nb, ps, h, hd] -> the slot's logical [s] view
+    k_seq = k_l[block_tables].reshape(b, s, num_heads, hd)
+    v_seq = v_l[block_tables].reshape(b, s, num_heads, hd)
+    scores = jnp.einsum("bhd,bshd->bhs", q, k_seq) / jnp.sqrt(
+        jnp.asarray(hd, jnp.float32)
+    )
+    visible = jnp.arange(s)[None, :] <= pos[:, None]  # [b, s]
+    scores = jnp.where(visible[:, None, :], scores, -1e30)
+    attn = jax.nn.softmax(scores, axis=-1).astype(v_seq.dtype)
+    ctx = jnp.einsum("bhs,bshd->bhd", attn, v_seq).reshape(b, d).astype(
+        x.dtype
+    )
+    x = x + ctx @ p["proj"]
+
+    h = _layer_norm(x, p["ln2"])
+    x = x + jax.nn.gelu(h @ p["w_in"], approximate=False) @ p["w_out"]
+    return x, k_l, v_l
+
+
+def forward_decode_paged(
+    params, token, cache, pos, block_tables, *, num_heads: int,
+    page_size: int,
+):
+    """Single-token decode step over the PAGED cache layout.
+
+    Same contract as :func:`forward_decode` — ``token``/``pos``: [B] int32,
+    returns ``(logits [B, vocab], new_cache)`` — but ``cache`` is the
+    global page pool ``{"k", "v"}`` each ``[pages, L, page_size, h, hd]``
+    and ``block_tables`` ([B, nb] int32) maps each slot's logical pages to
+    physical ones.  Identical math to the dense path (the bit-exactness
+    gate in ``tests/test_paged_cache.py`` pins it): the gathered page view
+    reconstructs exactly the dense ``[B, S, h, hd]`` key/value sequence,
+    padded with masked positions up to ``nb * page_size``.
+    """
+    x = params["embed"][token] + params["pos"][pos]  # [B, d]
+
+    def body(carry, xs):
+        p, k_l, v_l = xs
+        carry, k_l, v_l = _block_decode_paged(
+            p, carry, k_l, v_l, pos, block_tables,
+            num_heads=num_heads, page_size=page_size,
+        )
+        return carry, (k_l, v_l)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body,
+        x,
+        (
+            params["blocks"],
+            jnp.moveaxis(cache["k"], 1, 0),
+            jnp.moveaxis(cache["v"], 1, 0),
+        ),
+    )
+    new_cache = {
+        "k": jnp.moveaxis(k_new, 0, 1),
+        "v": jnp.moveaxis(v_new, 0, 1),
+    }
+    return x @ params["head"], new_cache
+
+
+def forward_prefill_chunk(
+    params, tokens, cache, block_table, offset, *, num_heads: int,
+    page_size: int,
+):
+    """One CHUNK of a prompt prefilled against the paged cache.
+
+    The chunked-prefill program: ``tokens`` [1, C] occupy logical
+    positions ``[offset, offset + C)`` of ONE sequence whose physical
+    pages are listed in ``block_table`` ([nb] int32).  Each layer writes
+    the chunk's K/V into the pages first, then attends over the gathered
+    page view — chunk token ``i`` sees every cached position
+    ``<= offset + i``: the whole already-prefilled history (earlier
+    chunks, shared prefix pages) plus the causal part of its own chunk.
+    Exactly :func:`block_apply`'s math with the key space routed through
+    the page pool.
+
+    Returns ``(logits [1, C, vocab], new_cache)``.  Positions that
+    overflow the block table (final-chunk padding) are routed to the
+    scratch page; their outputs are garbage and the caller ignores them.
+    """
+    b, C = tokens.shape
+    if b != 1:
+        raise ValueError(f"chunked prefill is per-sequence, got batch {b}")
+    nb = block_table.shape[0]
+    s = nb * page_size
+    posns = offset + jnp.arange(C)  # [C] logical positions
+    page_idx = posns // page_size
+    in_range = page_idx < nb
+    pages = jnp.where(
+        in_range, block_table[jnp.minimum(page_idx, nb - 1)], 0
+    )  # overflow (padding past max_seq) -> scratch page
+    offs = posns % page_size
+
+    max_len = params["pos"].shape[0]
+    x = (
+        params["embed"][tokens[0]]
+        + params["pos"][jnp.minimum(posns, max_len - 1)]
+    )  # [C, d]
+    d = x.shape[-1]
+    hd = d // num_heads
+
+    def body(carry, xs):
+        p, k_l, v_l = xs
+        h = _layer_norm(carry, p["ln1"])
+        qkv = h @ p["qkv"]  # [C, 3d]
+        q, k_c, v_c = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(C, num_heads, hd)
+        k_l = k_l.at[pages, offs].set(
+            k_c.reshape(C, num_heads, hd).astype(k_l.dtype)
+        )
+        v_l = v_l.at[pages, offs].set(
+            v_c.reshape(C, num_heads, hd).astype(v_l.dtype)
+        )
+        k_seq = k_l[block_table].reshape(s, num_heads, hd)
+        v_seq = v_l[block_table].reshape(s, num_heads, hd)
+        scores = jnp.einsum("chd,shd->chs", q, k_seq) / jnp.sqrt(
+            jnp.asarray(hd, jnp.float32)
+        )
+        visible = jnp.arange(s)[None, :] <= posns[:, None]  # [C, s]
+        scores = jnp.where(visible[:, None, :], scores, -1e30)
+        attn = jax.nn.softmax(scores, axis=-1).astype(v_seq.dtype)
+        ctx = jnp.einsum("chs,shd->chd", attn, v_seq).reshape(C, d).astype(
+            carry.dtype
+        )
+        out = carry + ctx @ p["proj"]
+        h = _layer_norm(out, p["ln2"])
+        out = out + jax.nn.gelu(h @ p["w_in"], approximate=False) @ p["w_out"]
+        return out, (k_l, v_l)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body,
+        x,
+        (
+            params["blocks"],
+            jnp.moveaxis(cache["k"], 1, 0),
+            jnp.moveaxis(cache["v"], 1, 0),
+        ),
+    )
+    new_cache = {
+        "k": jnp.moveaxis(k_new, 0, 1),
+        "v": jnp.moveaxis(v_new, 0, 1),
+    }
+    return (x @ params["head"])[None], new_cache
+
+
 # Which width dim of each stacked block leaf ZeRO-3 shards (leaf layout
 # AFTER the stage dim is [L/S, ...]; ln scales stay replicated).
 _ZERO3_WIDTH_DIM = {"qkv": 2, "proj": 1, "w_in": 2, "w_out": 1}

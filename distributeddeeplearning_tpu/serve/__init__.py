@@ -5,29 +5,44 @@ we learn"; this package answers the ROADMAP's other half — serving heavy
 traffic.  It is a separate column of the system, not a flag on the training
 loop (the Podracer actor/learner decomposition, arxiv 2104.06272):
 
-- :mod:`serve.kv_cache` — a preallocated, slot-indexed KV cache pytree
-  sharded over the training mesh's axes;
+- :mod:`serve.kv_cache` — two cache layouts: a preallocated slot-indexed
+  dense cache pytree sharded over the training mesh's axes, and a PAGED
+  pool of fixed-size pages with a host-side allocator (refcounts, free
+  list, reusable-prefix table) so HBM is committed per actual token;
 - :mod:`serve.engine` — jitted prefill (the Pallas flash-attention prompt
   pass) and single-token decode with cache donation, plus greedy /
-  temperature / top-k sampling under the train-step RNG convention;
+  temperature / top-k sampling under the train-step RNG convention; the
+  paged engine adds block-table-gather decode, chunked prefill, and
+  shared-prefix reuse;
 - :mod:`serve.scheduler` — continuous batching: a request queue feeding
-  cache slots, mid-flight slot release on EOS/length, and per-request
-  latency (TTFT, per-token) + aggregate throughput accounting.
+  cache slots, admission bounded by free pages under the paged layout,
+  prefill chunks interleaved with decode steps, mid-flight slot release
+  on EOS/length, and per-request latency (TTFT, queue wait, per-token)
+  + aggregate throughput accounting.
 
-Entry points: ``ddlt serve`` (CLI) and ``bench.py --serve`` (the
-``SERVE_*.json`` artifact).
+Entry points: ``ddlt serve`` (CLI, ``--kv-layout dense|paged``) and
+``bench.py --serve`` (the ``SERVE_*.json`` / ``SERVE_PAGED_*.json``
+artifacts).
 """
 
 from distributeddeeplearning_tpu.serve.engine import (
     InferenceEngine,
+    PagedInferenceEngine,
+    PrefillTask,
     data_parallel_engine,
     sample_logits,
 )
 from distributeddeeplearning_tpu.serve.kv_cache import (
+    OutOfPages,
+    PageAllocator,
     cache_bytes,
     cache_sharding,
     init_cache,
+    init_paged_cache,
+    insert_pages,
     insert_sequence,
+    page_bytes,
+    pages_for,
 )
 from distributeddeeplearning_tpu.serve.scheduler import (
     CompletedRequest,
@@ -39,13 +54,21 @@ from distributeddeeplearning_tpu.serve.scheduler import (
 
 __all__ = [
     "InferenceEngine",
+    "PagedInferenceEngine",
+    "PrefillTask",
     "data_parallel_engine",
     "sample_logits",
     "synthetic_requests",
     "init_cache",
+    "init_paged_cache",
     "insert_sequence",
+    "insert_pages",
     "cache_sharding",
     "cache_bytes",
+    "page_bytes",
+    "pages_for",
+    "OutOfPages",
+    "PageAllocator",
     "Request",
     "CompletedRequest",
     "ContinuousBatchingScheduler",

@@ -38,13 +38,21 @@ import numpy as np
 
 from distributeddeeplearning_tpu.models.pipelined_transformer import (
     forward_decode,
+    forward_decode_paged,
     forward_prefill,
+    forward_prefill_chunk,
 )
 from distributeddeeplearning_tpu.serve.kv_cache import (
+    OutOfPages,
+    PageAllocator,
+    SCRATCH_PAGE,
     cache_bytes,
     cache_sharding,
     init_cache,
+    init_paged_cache,
     insert_sequence,
+    page_bytes,
+    pages_for,
 )
 
 logger = logging.getLogger("ddlt.serve.engine")
@@ -64,6 +72,10 @@ def sample_logits(
     ``temperature <= 0`` is greedy argmax (rng unused — a greedy run is
     bitwise deterministic); otherwise logits outside the top ``top_k``
     (when set) are masked before a temperature-scaled categorical draw.
+    The mask keeps EXACTLY ``top_k`` logits: ties at the k-th value are
+    broken deterministically by ``lax.top_k``'s lowest-index-first order
+    (a ``logits < kth`` threshold mask would let every tied logit through
+    and sample from more than ``top_k`` candidates).
     """
     if top_k is not None and top_k < 1:
         # top_k=0 would otherwise surface as an opaque broadcast error
@@ -73,8 +85,11 @@ def sample_logits(
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if top_k is not None and top_k < logits.shape[-1]:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, NEG_BIG, logits)
+        idx = jax.lax.top_k(logits, top_k)[1]  # [..., k], ties by index
+        keep = jax.nn.one_hot(
+            idx, logits.shape[-1], dtype=jnp.bool_
+        ).any(axis=-2)
+        logits = jnp.where(keep, logits, NEG_BIG)
     return jax.random.categorical(rng, logits / temperature, axis=-1).astype(
         jnp.int32
     )
@@ -89,6 +104,25 @@ def prompt_bucket(n: int, max_seq: int, floor: int = 8) -> int:
     while b < n:
         b *= 2
     return min(b, max_seq)
+
+
+def _validate_model_dims(params, *, num_heads: int, max_seq: int, top_k):
+    """Construction-time checks both engine layouts share; returns
+    ``(d_model, num_layers, head_dim)`` from the param shapes."""
+    pos_table = params["pos"].shape[0]
+    if max_seq > pos_table:
+        raise ValueError(
+            f"max_seq {max_seq} exceeds the model's position table "
+            f"{pos_table} — re-init the params with max_len >= max_seq"
+        )
+    d_model = params["embed"].shape[1]
+    if d_model % num_heads:
+        raise ValueError(
+            f"d_model {d_model} not divisible by heads {num_heads}"
+        )
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    return d_model, params["blocks"]["qkv"].shape[0], d_model // num_heads
 
 
 def data_parallel_engine(params, *, num_heads: int, batch_slots: int,
@@ -126,6 +160,11 @@ class InferenceEngine:
     - ``decode(tokens, pos) -> next tokens`` — one step for ALL slots
       (the scheduler masks the inactive ones).
 
+    This is the DENSE layout (``kv_layout="dense"``): every slot reserves
+    ``max_seq`` cache positions.  :class:`PagedInferenceEngine` is the
+    pay-per-token alternative; both satisfy the same scheduler protocol
+    (``can_admit`` / ``release`` / ``prefill_compiles``).
+
     ``prefill_attention="flash"`` (default) runs the prompt pass through
     the Pallas kernel; tiny prompts fall back to dense inside
     ``ops.flash_attention`` (the auto-block floor).  Decode is always
@@ -147,19 +186,16 @@ class InferenceEngine:
         rng: Optional[jax.Array] = None,
         pad_id: int = 0,
     ):
-        pos_table = params["pos"].shape[0]
-        if max_seq > pos_table:
-            raise ValueError(
-                f"max_seq {max_seq} exceeds the model's position table "
-                f"{pos_table} — re-init the params with max_len >= max_seq"
-            )
-        d_model = params["embed"].shape[1]
-        if d_model % num_heads:
-            raise ValueError(
-                f"d_model {d_model} not divisible by heads {num_heads}"
-            )
-        if top_k is not None and top_k < 1:
-            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        self.kv_layout = "dense"
+        self.chunked_prefill = False
+        # distinct compiled prefill shapes (each new power-of-two bucket
+        # is a mid-run jit recompile — ServeReport surfaces the count so
+        # benchmark warmup can prove it drove them all to 0)
+        self.prefill_compiles = 0
+        self._seen_buckets: set = set()
+        _, num_layers, head_dim = _validate_model_dims(
+            params, num_heads=num_heads, max_seq=max_seq, top_k=top_k
+        )
         self.params = params
         self.num_heads = num_heads
         self.batch_slots = batch_slots
@@ -167,8 +203,6 @@ class InferenceEngine:
         self.mesh = mesh
         self.pad_id = pad_id
         self.vocab_size = params["head"].shape[1]
-        num_layers = params["blocks"]["qkv"].shape[0]
-        head_dim = d_model // num_heads
         if cache_dtype is None:
             cache_dtype = params["embed"].dtype
         self._base_rng = jax.random.key(0) if rng is None else rng
@@ -261,6 +295,26 @@ class InferenceEngine:
     def cache(self):
         return self._cache
 
+    def kv_bytes(self) -> int:
+        """Total KV pool bytes (the HBM the layout RESERVES)."""
+        return cache_bytes(self._cache)
+
+    def kv_bytes_peak(self) -> int:
+        """Peak KV bytes actually committed to sequences — for the dense
+        layout that is the whole reservation (every slot holds ``max_seq``
+        positions whether used or not), which is exactly the number the
+        paged layout exists to shrink."""
+        return cache_bytes(self._cache)
+
+    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """Dense slots always fit a (validated) request — admission is
+        gated by the scheduler's free-slot list alone."""
+        return True
+
+    def release(self, slot: int) -> None:
+        """No device state to reclaim: the slot's stale K/V stay masked
+        behind the next occupant's positions."""
+
     def _next_step(self) -> int:
         step = self._sample_step
         self._sample_step += 1
@@ -281,6 +335,9 @@ class InferenceEngine:
         if not 0 <= slot < self.batch_slots:
             raise ValueError(f"slot {slot} out of range [0, {self.batch_slots})")
         bucket = prompt_bucket(length, self.max_seq)
+        if bucket not in self._seen_buckets:
+            self._seen_buckets.add(bucket)
+            self.prefill_compiles += 1
         tokens = np.full((1, bucket), self.pad_id, np.int32)
         tokens[0, :length] = np.asarray(prompt, np.int32)
         last, k, v = self._prefill_jit(
@@ -306,3 +363,402 @@ class InferenceEngine:
             jnp.int32(self._next_step()),
         )
         return np.asarray(toks)
+
+
+class PrefillTask:
+    """In-flight chunked prefill of one request: the scheduler advances it
+    one chunk at a time (``PagedInferenceEngine.prefill_step``) between
+    decode steps, so a long prompt never stalls running requests for its
+    full O(P²) pass."""
+
+    __slots__ = ("slot", "prompt", "pages", "offset", "shared_tokens")
+
+    def __init__(self, slot, prompt, pages, offset, shared_tokens):
+        self.slot = slot
+        self.prompt = list(prompt)
+        self.pages = pages  # this sequence's block table (physical ids)
+        self.offset = offset  # tokens already in cache (shared + chunked)
+        self.shared_tokens = shared_tokens  # prefix-cache hit length
+
+    @property
+    def done(self) -> bool:
+        return self.offset >= len(self.prompt)
+
+
+class PagedInferenceEngine:
+    """Paged-KV-cache generation: HBM by actual tokens, not ``max_seq``.
+
+    Same scheduler verbs as :class:`InferenceEngine` plus the paged
+    extras:
+
+    - ``can_admit(prompt_len, budget)`` — enough pages free (admission is
+      bounded by the POOL, not a fixed per-slot reservation)?
+    - ``prefill_begin(slot, prompt, budget) -> PrefillTask`` — allocate
+      the sequence's pages (reusing prefix-cache hits: leading full pages
+      whose token ids match skip prefill entirely) and map its block
+      table;
+    - ``prefill_step(task) -> first token | None`` — run ONE prompt chunk
+      through the compiled chunk program (``forward_prefill_chunk``);
+      returns the first sampled token once the last chunk lands;
+    - ``decode(tokens, pos)`` — one step for all slots via block-table
+      gather (``forward_decode_paged``);
+    - ``release(slot)`` — decref the slot's pages; full prompt pages
+      stay in the prefix table (reclaimable) for future hits.
+
+    Decode math is bit-identical to the dense engine (the gathered page
+    view IS the dense key sequence), so greedy runs produce the same
+    tokens under either layout — ``tests/test_paged_cache.py`` pins it.
+    Single-mesh only: the block-table gather crosses the page axis, which
+    would be a cross-device gather under a sharded pool.
+    """
+
+    def __init__(
+        self,
+        params,
+        *,
+        num_heads: int,
+        batch_slots: int,
+        max_seq: int,
+        page_size: int = 64,
+        num_pages: Optional[int] = None,
+        prefill_chunk: int = 64,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        cache_dtype=None,
+        rng: Optional[jax.Array] = None,
+        pad_id: int = 0,
+        prefix_cache: bool = True,
+    ):
+        _, num_layers, head_dim = _validate_model_dims(
+            params, num_heads=num_heads, max_seq=max_seq, top_k=top_k
+        )
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+            )
+        self.kv_layout = "paged"
+        self.chunked_prefill = True
+        self.params = params
+        self.num_heads = num_heads
+        self.batch_slots = batch_slots
+        self.max_seq = max_seq
+        self.page_size = page_size
+        self.prefill_chunk = prefill_chunk
+        self.pad_id = pad_id
+        self.mesh = None
+        self.vocab_size = params["head"].shape[1]
+        if cache_dtype is None:
+            cache_dtype = params["embed"].dtype
+        self._base_rng = jax.random.key(0) if rng is None else rng
+        self._sample_step = 0
+
+        # pages each slot can address — the static block-table width
+        self.blocks_per_slot = pages_for(max_seq, page_size)
+        if num_pages is None:
+            # capacity parity with the dense layout; real deployments set
+            # it LOWER (that is the HBM win) and let admission backpressure
+            num_pages = batch_slots * self.blocks_per_slot
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        self.num_pages = num_pages
+        self.allocator = PageAllocator(num_pages)
+        self._prefix_enabled = prefix_cache
+        self._cache = init_paged_cache(
+            num_pages=num_pages,
+            num_layers=num_layers,
+            page_size=page_size,
+            num_heads=num_heads,
+            head_dim=head_dim,
+            dtype=cache_dtype,
+        )
+        self._page_bytes = page_bytes(self._cache)
+        # host-side block tables, one row per slot; scratch-filled rows
+        # make released/empty slots write into the dustbin page
+        self._block_tables = np.full(
+            (batch_slots, self.blocks_per_slot), SCRATCH_PAGE, np.int32
+        )
+        self._slot_pages: dict = {}
+
+        # stats the scheduler/bench surface
+        self.prefill_compiles = 0
+        self._seen_chunk_shapes: set = set()
+        self.prefix_hit_tokens = 0
+        self.prompt_tokens_seen = 0
+        self.pages_peak = 0
+
+        temperature = float(temperature)
+        base_rng = self._base_rng
+
+        def _sample(logits, step):
+            return sample_logits(
+                logits,
+                jax.random.fold_in(base_rng, step),
+                temperature=temperature,
+                top_k=top_k,
+            )
+
+        def _chunk_fn(params, cache, tokens, block_table, offset):
+            return forward_prefill_chunk(
+                params, tokens, cache, block_table, offset,
+                num_heads=num_heads, page_size=page_size,
+            )
+
+        def _decode_fn(params, cache, tokens, pos, block_tables, step):
+            logits, cache = forward_decode_paged(
+                params, tokens, cache, pos, block_tables,
+                num_heads=num_heads, page_size=page_size,
+            )
+            return _sample(logits, step), cache
+
+        # one compiled chunk program per chunk shape (<= log2(chunk) of
+        # them: full chunks plus power-of-two final-chunk buckets)
+        self._chunk_jit = jax.jit(_chunk_fn, donate_argnums=(1,))
+        self._decode_jit = jax.jit(_decode_fn, donate_argnums=(1,))
+        self._sample_jit = jax.jit(_sample)
+        logger.info(
+            "paged engine: %d slots, %d pages x %d tokens (+scratch), %d "
+            "layers, pool %.1f MB (%s), chunk %d, prefix cache %s",
+            batch_slots, num_pages, page_size, num_layers,
+            cache_bytes(self._cache) / 1e6, np.dtype(cache_dtype).name,
+            prefill_chunk, "on" if prefix_cache else "off",
+        )
+
+    # -- accounting --------------------------------------------------------
+    @property
+    def cache(self):
+        return self._cache
+
+    @property
+    def block_tables(self) -> np.ndarray:
+        return self._block_tables
+
+    def kv_bytes(self) -> int:
+        return cache_bytes(self._cache)
+
+    def kv_bytes_peak(self) -> int:
+        """Peak bytes of LIVE pages — HBM actually committed to sequences
+        (the pay-per-token number the paged layout is for)."""
+        return self.pages_peak * self._page_bytes
+
+    def prefix_hit_rate(self) -> float:
+        if not self.prompt_tokens_seen:
+            return 0.0
+        return self.prefix_hit_tokens / self.prompt_tokens_seen
+
+    def reset_stats(self) -> None:
+        """Zero the run counters (benchmark warmup hygiene); the prefix
+        TABLE survives — call ``clear_prefix_cache`` to drop that too."""
+        self.prefill_compiles = 0
+        self.prefix_hit_tokens = 0
+        self.prompt_tokens_seen = 0
+        self.pages_peak = 0
+
+    def clear_prefix_cache(self) -> None:
+        self.allocator.clear_prefix()
+
+    def chunk_shapes(self, prompt_len: int) -> set:
+        """The compiled chunk widths a prompt of ``prompt_len`` will run
+        (mirrors ``prefill_step``'s chunking) — warmup drivers enumerate
+        these to compile every shape before the timed phase."""
+        shapes = set()
+        off = 0
+        while off < prompt_len:
+            rem = prompt_len - off
+            C = (
+                self.prefill_chunk
+                if rem >= self.prefill_chunk
+                else prompt_bucket(rem, self.prefill_chunk)
+            )
+            shapes.add(C)
+            off += min(rem, C)
+        return shapes
+
+    def required_pages(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Pages a request needs end-to-end: its prompt plus its token
+        budget, capped at the per-slot addressable window."""
+        total = min(prompt_len + max_new_tokens, self.max_seq)
+        return pages_for(total, self.page_size)
+
+    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """Admission backpressure: pages are reserved WORST-CASE at
+        admission (prompt + full budget), so decode can never strand a
+        half-generated sequence out of memory mid-flight.  Conservative —
+        a prefix-cache hit at ``prefill_begin`` needs fewer fresh pages."""
+        return (
+            self.required_pages(prompt_len, max_new_tokens)
+            <= self.allocator.available
+        )
+
+    def fits(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """False when the request exceeds the POOL itself — waiting for
+        completions can never help; the scheduler fails it instead of
+        deadlocking the queue."""
+        return self.required_pages(prompt_len, max_new_tokens) <= self.num_pages
+
+    def _next_step(self) -> int:
+        step = self._sample_step
+        self._sample_step += 1
+        return step
+
+    # -- prefill -----------------------------------------------------------
+    def _prefix_key(self, prompt, n_pages: int):
+        # key = full token history through the end of page n — a hit
+        # guarantees the page holds exactly prefill's K/V for those tokens
+        return tuple(prompt[: n_pages * self.page_size])
+
+    def prefill_begin(
+        self, slot: int, prompt: Sequence[int], max_new_tokens: int
+    ) -> PrefillTask:
+        """Allocate the sequence's pages (prefix-cache hits first), map
+        the slot's block table, and return the chunking task."""
+        length = len(prompt)
+        if not length:
+            raise ValueError("empty prompt")
+        if length >= self.max_seq:
+            raise ValueError(
+                f"prompt length {length} leaves no room to generate "
+                f"(max_seq {self.max_seq})"
+            )
+        if not 0 <= slot < self.batch_slots:
+            raise ValueError(
+                f"slot {slot} out of range [0, {self.batch_slots})"
+            )
+        if slot in self._slot_pages:
+            raise ValueError(f"slot {slot} still holds pages — release first")
+        ps = self.page_size
+        n_total = self.required_pages(length, max_new_tokens)
+
+        # prefix reuse: walk the chain of FULL prompt pages.  Capped at
+        # length-1 tokens so at least the last prompt token always runs
+        # through prefill — its logits seed the first sampled token.
+        shared: list = []
+        if self._prefix_enabled:
+            max_shared = (length - 1) // ps
+            for i in range(max_shared):
+                page = self.allocator.lookup_prefix(
+                    self._prefix_key(prompt, i + 1)
+                )
+                if page is None:
+                    break
+                shared.append(page)
+        for p in shared:
+            self.allocator.incref(p)
+        try:
+            fresh = self.allocator.alloc(n_total - len(shared))
+        except OutOfPages:
+            for p in shared:  # roll the hit refs back before backpressure
+                self.allocator.decref(p)
+            raise
+        pages = shared + fresh
+        self._slot_pages[slot] = pages
+        # The slot's _block_tables row stays SCRATCH until the final chunk
+        # lands (prefill_step installs it): decode steps run WHILE this
+        # slot is mid-prefill, and every decode lane writes unconditionally
+        # — with the real row installed, the stale lane's (pos 0) write
+        # would corrupt the prompt's already-written K/V or a SHARED
+        # prefix page.  The chunk program gets a task-local table instead.
+        self.pages_peak = max(self.pages_peak, self.allocator.pages_in_use)
+        offset = len(shared) * ps
+        self.prompt_tokens_seen += length
+        self.prefix_hit_tokens += offset
+        return PrefillTask(slot, prompt, pages, offset, offset)
+
+    def prefill_step(self, task: PrefillTask) -> Optional[int]:
+        """Run ONE chunk of ``task``'s prompt; returns the first sampled
+        continuation token when the final chunk completes, else None."""
+        if task.done:
+            raise ValueError("prefill task already complete")
+        length = len(task.prompt)
+        rem = length - task.offset
+        # full chunks, then a power-of-two bucket for the remainder —
+        # bounds compiled chunk shapes to log2(prefill_chunk) + 1
+        C = (
+            self.prefill_chunk
+            if rem >= self.prefill_chunk
+            else prompt_bucket(rem, self.prefill_chunk)
+        )
+        real = min(rem, C)
+        if C not in self._seen_chunk_shapes:
+            self._seen_chunk_shapes.add(C)
+            self.prefill_compiles += 1
+        tokens = np.full((1, C), self.pad_id, np.int32)
+        tokens[0, :real] = np.asarray(
+            task.prompt[task.offset : task.offset + real], np.int32
+        )
+        # task-local block table: the slot's shared row is still SCRATCH
+        # (see prefill_begin) so interleaved decode steps can't touch
+        # these pages until the prompt is fully written
+        table = np.full(self.blocks_per_slot, SCRATCH_PAGE, np.int32)
+        table[: len(task.pages)] = task.pages
+        logits, self._cache = self._chunk_jit(
+            self.params,
+            self._cache,
+            jnp.asarray(tokens),
+            jnp.asarray(table),
+            jnp.int32(task.offset),
+        )
+        chunk_start = task.offset
+        task.offset += real
+        # publish freshly completed FULL prompt pages for prefix reuse —
+        # immediately, so same-wave requests sharing the prefix hit too
+        if self._prefix_enabled:
+            first_new = chunk_start // self.page_size
+            last_full = min(task.offset, length) // self.page_size
+            for i in range(first_new, last_full):
+                self.allocator.register_prefix(
+                    self._prefix_key(task.prompt, i + 1), task.pages[i]
+                )
+        if not task.done:
+            return None
+        # prompt fully written: NOW the slot's decode row may see the pages
+        self._block_tables[task.slot] = SCRATCH_PAGE
+        self._block_tables[task.slot, : len(task.pages)] = task.pages
+        last = jax.lax.dynamic_index_in_dim(
+            logits, real - 1, axis=1, keepdims=False
+        )  # [1, vocab] — last REAL position of the final chunk
+        tok = self._sample_jit(last, jnp.int32(self._next_step()))
+        return int(np.asarray(tok)[0])
+
+    def prefill(
+        self,
+        slot: int,
+        prompt: Sequence[int],
+        max_new_tokens: Optional[int] = None,
+    ) -> int:
+        """Monolithic convenience: run every chunk back-to-back (API
+        parity with the dense engine for tests/direct use; the scheduler
+        interleaves ``prefill_step`` with decode instead).  Without a
+        budget the slot reserves through ``max_seq`` — dense-equivalent
+        worst case."""
+        if max_new_tokens is None:
+            max_new_tokens = self.max_seq - len(prompt)
+        task = self.prefill_begin(slot, prompt, max_new_tokens)
+        while True:
+            tok = self.prefill_step(task)
+            if tok is not None:
+                return tok
+
+    # -- decode / release --------------------------------------------------
+    def decode(self, tokens: np.ndarray, pos: np.ndarray) -> np.ndarray:
+        """One decode step for every slot via block-table gather.  Same
+        contract as the dense engine; released slots' rows point at the
+        scratch page so their (ignored) lane writes are harmless."""
+        toks, self._cache = self._decode_jit(
+            self.params,
+            self._cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(pos, jnp.int32),
+            jnp.asarray(self._block_tables),
+            jnp.int32(self._next_step()),
+        )
+        return np.asarray(toks)
+
+    def release(self, slot: int) -> None:
+        """Return the slot's pages to the pool.  Prefix-registered pages
+        drop to the reclaimable LRU (future hits resurrect them); private
+        pages go straight back to the free list."""
+        for page in self._slot_pages.pop(slot, []):
+            self.allocator.decref(page)
+        self._block_tables[slot] = SCRATCH_PAGE

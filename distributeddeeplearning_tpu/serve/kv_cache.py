@@ -21,7 +21,8 @@ mutates device buffers beyond the K/V writes themselves.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +31,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
 
 Cache = Dict[str, jax.Array]
+
+#: Page id 0 is a reserved scratch page: released/inactive decode slots and
+#: out-of-range block-table entries point at it, so their (masked, ignored)
+#: K/V writes can never corrupt a live sequence's pages.
+SCRATCH_PAGE = 0
 
 
 def init_cache(
@@ -84,3 +90,234 @@ def insert_sequence(cache: Cache, k: jax.Array, v: jax.Array, slot) -> Cache:
 def cache_bytes(cache: Cache) -> int:
     """Total cache footprint in bytes (the serving HBM budget line)."""
     return sum(leaf.size * leaf.dtype.itemsize for leaf in cache.values())
+
+
+# --------------------------------------------------------------------------
+# Paged layout: a global pool of fixed-size pages + per-slot block tables.
+#
+# The dense layout above reserves ``max_seq`` positions per slot whether or
+# not the sequence ever grows that long; the paged layout allocates HBM by
+# ACTUAL tokens: ``k, v: [num_pages, L, page_size, h, hd]`` and each slot
+# owns a host-side list of page ids (its block table).  Logical position
+# ``j`` of a slot lives at ``(table[j // page_size], j % page_size)``.
+# Admissible concurrency is then bounded by free pages, not by ``slots ×
+# max_seq`` reservations, and identical prompt prefixes can SHARE physical
+# pages (refcounted — a full page whose token ids match an already-cached
+# chunk is mapped, not recomputed).
+# --------------------------------------------------------------------------
+
+
+def init_paged_cache(
+    *,
+    num_pages: int,
+    num_layers: int,
+    page_size: int,
+    num_heads: int,
+    head_dim: int,
+    dtype: Any = jnp.float32,
+) -> Cache:
+    """Zero-filled page pool ``{"k", "v"}``, each [pages, L, page_size, h, hd].
+
+    ``num_pages`` counts USABLE pages; one extra scratch page (id 0,
+    :data:`SCRATCH_PAGE`) is prepended so inactive decode lanes have a safe
+    write target.  Page-major so one page is a contiguous leading-dim slice
+    and the block-table gather in ``forward_decode_paged`` is a single
+    leading-axis take.
+    """
+    if num_pages < 1:
+        raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    shape = (num_pages + 1, num_layers, page_size, num_heads, head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def page_bytes(cache: Cache) -> int:
+    """Bytes of ONE page across k+v and all layers — the HBM granule the
+    allocator hands out (``cache_bytes == (num_pages+1) * page_bytes``)."""
+    return sum(
+        leaf.size // leaf.shape[0] * leaf.dtype.itemsize
+        for leaf in cache.values()
+    )
+
+
+def pages_for(tokens: int, page_size: int) -> int:
+    """Pages covering ``tokens`` positions (ceil division)."""
+    return -(-tokens // page_size)
+
+
+class OutOfPages(RuntimeError):
+    """Page pool exhausted — the admission-backpressure signal.
+
+    The scheduler treats this as "wait for completions to free pages", not
+    as a request failure, unless the request can never fit the pool."""
+
+
+class PageAllocator:
+    """Host-side bookkeeping for the page pool: free list, refcounts, and
+    a prefix table of reusable immutable pages.
+
+    Pages move through three states:
+
+    - **free** — on the free list, contents meaningless;
+    - **live** — refcount >= 1, owned by one or more block tables (a page
+      shared via the prefix table is live in several tables at once);
+    - **reclaimable** — refcount == 0 but still registered in the prefix
+      table (its token contents remain valid), kept in LRU order.  A
+      prefix lookup resurrects it (incref); allocation pressure evicts it
+      (drops the prefix entry, hands the page out fresh).
+
+    The prefix table maps ``key -> page`` where ``key`` identifies the
+    FULL token history through the end of that page (the engine uses
+    ``tuple(prompt[: (i+1) * page_size])``), so a hit guarantees the
+    page's K/V are bit-identical to what prefill would recompute.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        self.num_pages = num_pages
+        # page ids 1..num_pages (0 is the scratch page, never allocated)
+        self._free: List[int] = list(range(num_pages, 0, -1))
+        self._rc: Dict[int, int] = {}
+        self._prefix: Dict[Any, int] = {}
+        self._page_key: Dict[int, Any] = {}
+        self._reclaim: "OrderedDict[int, None]" = OrderedDict()
+
+    # -- capacity ----------------------------------------------------------
+    @property
+    def available(self) -> int:
+        """Pages an ``alloc`` could hand out right now (free + evictable)."""
+        return len(self._free) + len(self._reclaim)
+
+    @property
+    def pages_in_use(self) -> int:
+        """Live pages (refcount >= 1)."""
+        return self.num_pages - self.available
+
+    # -- alloc / refcount --------------------------------------------------
+    def alloc(self, n: int) -> List[int]:
+        """Hand out ``n`` pages at refcount 1, evicting LRU reclaimable
+        prefix pages as needed.  Raises :class:`OutOfPages` (allocating
+        nothing) when fewer than ``n`` are available."""
+        if n < 0:
+            raise ValueError(f"cannot alloc {n} pages")
+        if n > self.available:
+            raise OutOfPages(
+                f"need {n} pages, {self.available} available "
+                f"({self.pages_in_use}/{self.num_pages} live)"
+            )
+        out: List[int] = []
+        for _ in range(n):
+            if self._free:
+                page = self._free.pop()
+            else:  # evict the least-recently-used cached prefix page
+                page, _ = self._reclaim.popitem(last=False)
+                del self._prefix[self._page_key.pop(page)]
+            self._rc[page] = 1
+            out.append(page)
+        return out
+
+    def incref(self, page: int) -> None:
+        rc = self._rc.get(page, 0)
+        if rc == 0:
+            if page not in self._reclaim:
+                raise ValueError(f"incref on non-live page {page}")
+            del self._reclaim[page]  # resurrected from the prefix table
+        self._rc[page] = rc + 1
+
+    def decref(self, page: int) -> None:
+        rc = self._rc.get(page, 0)
+        if rc < 1:
+            raise ValueError(f"decref on non-live page {page}")
+        if rc > 1:
+            self._rc[page] = rc - 1
+            return
+        del self._rc[page]
+        if page in self._page_key:
+            # still named by the prefix table: keep its contents around
+            # for future hits until allocation pressure evicts it
+            self._reclaim[page] = None
+        else:
+            self._free.append(page)
+
+    def refcount(self, page: int) -> int:
+        return self._rc.get(page, 0)
+
+    # -- prefix table ------------------------------------------------------
+    def lookup_prefix(self, key) -> Optional[int]:
+        """Page holding ``key``'s chunk, or None.  Does NOT incref — the
+        caller takes the reference explicitly (and marks recency)."""
+        page = self._prefix.get(key)
+        if page is not None and page in self._reclaim:
+            self._reclaim.move_to_end(page)  # LRU touch
+        return page
+
+    def register_prefix(self, key, page: int) -> None:
+        """Publish a live, fully-written, immutable page for reuse.  A key
+        already registered keeps its existing page (first writer wins —
+        both copies hold identical K/V, so dropping the duplicate
+        registration is purely an HBM-dedup decision)."""
+        if self._rc.get(page, 0) < 1:
+            raise ValueError(f"cannot register non-live page {page}")
+        if key in self._prefix or page in self._page_key:
+            return
+        self._prefix[key] = page
+        self._page_key[page] = key
+
+    def clear_prefix(self) -> None:
+        """Drop every prefix entry; reclaimable pages return to the free
+        list (benchmark hygiene: warmup must not seed the timed run)."""
+        for page in list(self._reclaim):
+            del self._prefix[self._page_key.pop(page)]
+            self._free.append(page)
+        self._reclaim.clear()
+        for page in list(self._page_key):  # live pages: unregister only
+            del self._prefix[self._page_key.pop(page)]
+
+    @property
+    def prefix_entries(self) -> int:
+        return len(self._prefix)
+
+    # -- invariants (test hook) -------------------------------------------
+    def check(self) -> None:
+        """Assert the allocator's internal invariants (tests call this
+        after every mutation pattern)."""
+        live = set(self._rc)
+        free = set(self._free)
+        reclaim = set(self._reclaim)
+        assert not (live & free), "page both live and free"
+        assert not (live & reclaim), "page both live and reclaimable"
+        assert not (free & reclaim), "page both free and reclaimable"
+        assert len(free) == len(self._free), "duplicate free-list entry"
+        assert live | free | reclaim == set(range(1, self.num_pages + 1)), \
+            "page leaked (not live, free, or reclaimable)"
+        assert all(rc >= 1 for rc in self._rc.values())
+        assert reclaim <= set(self._page_key), "reclaimable page unnamed"
+        for key, page in self._prefix.items():
+            assert self._page_key.get(page) == key, "prefix maps diverged"
+
+
+def insert_pages(
+    cache: Cache,
+    k: jax.Array,
+    v: jax.Array,
+    page_ids: jax.Array,
+    *,
+    page_size: int,
+) -> Cache:
+    """Scatter a prefilled prompt's K/V ([L, P, h, hd], P a multiple of
+    ``page_size``) into the pool pages listed in ``page_ids`` — the paged
+    analogue of :func:`insert_sequence`, used by tests and one-shot
+    (non-chunked) inserts; the engine's chunked prefill writes pages inside
+    the compiled chunk program instead."""
+    if k.ndim == 5:
+        k, v = k[0], v[0]
+    L, P, h, hd = k.shape
+    n = P // page_size
+    paged_k = k.reshape(L, n, page_size, h, hd).swapaxes(0, 1)
+    paged_v = v.reshape(L, n, page_size, h, hd).swapaxes(0, 1)
+    return {
+        "k": cache["k"].at[page_ids].set(paged_k.astype(cache["k"].dtype)),
+        "v": cache["v"].at[page_ids].set(paged_v.astype(cache["v"].dtype)),
+    }

@@ -9,14 +9,30 @@ a fixed [slots] shape, so admission/release is pure host bookkeeping plus
 one prefill+insert for the newcomer.
 
 The scheduler is deliberately host-side and synchronous — one decode step
-per loop iteration, admission between steps.  What it records is the whole
-point of serving benchmarks:
+per loop iteration, admission between steps.  Two engine layouts plug in
+behind one protocol:
+
+- dense (:class:`~distributeddeeplearning_tpu.serve.engine.InferenceEngine`):
+  admission is gated by free slots alone, prefill runs monolithically at
+  admission;
+- paged (:class:`~...engine.PagedInferenceEngine`, ``chunked_prefill``):
+  admission additionally requires free PAGES (``engine.can_admit`` —
+  backpressure instead of a mid-decode out-of-memory), and prefill runs
+  one CHUNK per loop iteration interleaved with decode steps, so a long
+  prompt's O(P²) pass never stalls running requests for more than one
+  chunk; completed requests ``engine.release`` their pages back to the
+  pool (prefix-cached pages stay reclaimable for future hits).
+
+What it records is the whole point of serving benchmarks:
 
 - per-request TTFT (arrival → first token, queue wait included — the
-  number a user feels),
+  number a user feels) and queue wait (arrival → admission) separately,
+  so scheduler-induced latency is visible apart from prefill latency,
 - per-decode-step latency (≈ inter-token latency at full occupancy),
 - aggregate generated tokens/s and mean slot occupancy (how close the
-  engine runs to its throughput ceiling).
+  engine runs to its throughput ceiling),
+- ``prefill_compiles``: prefill shapes compiled DURING the run (each one
+  was a mid-run jit stall; warmup should drive it to 0).
 """
 
 from __future__ import annotations
@@ -46,10 +62,11 @@ class CompletedRequest:
     uid: str
     prompt_len: int
     tokens: List[int]
-    finish_reason: str  # "eos" | "length" | "error"
+    finish_reason: str  # "eos" | "length" | "error" | "step_cap" | "cancelled"
     ttft_s: float
     total_s: float
     error: Optional[str] = None  # set when finish_reason == "error"
+    queue_wait_s: float = 0.0  # arrival -> admission (scheduler latency)
 
 
 @dataclasses.dataclass
@@ -59,6 +76,7 @@ class _SlotState:
     generated: List[int]
     next_pos: int  # position the NEXT decode input token occupies
     ttft_s: float
+    queue_wait_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -79,6 +97,17 @@ class ServeReport:
     # requests that ended with finish_reason == "error" (per-request fault
     # isolation: one bad request must not kill the batch)
     errors: int = 0
+    # arrival -> admission percentiles: the scheduler-induced share of
+    # TTFT, separated so queueing can't masquerade as prefill latency
+    queue_wait_s: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # prefill shapes compiled during THIS run (mid-run jit stalls)
+    prefill_compiles: int = 0
+    kv_layout: str = "dense"
+    prefix_hit_rate: float = 0.0  # prompt tokens served from shared pages
+    kv_bytes: int = 0  # KV pool bytes reserved
+    # peak bytes committed to live sequences — equals kv_bytes under the
+    # dense layout (the whole reservation is always committed)
+    kv_bytes_peak: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -90,20 +119,32 @@ def synthetic_requests(
     vocab_size: int,
     max_prompt: int,
     min_prompt: int = 2,
+    shared_prefix_len: int = 0,
     rng: Optional[np.random.Generator] = None,
 ) -> List[Request]:
     """``n`` random-token requests with lengths in [min_prompt, max_prompt]
     — the shared prompt source of ``ddlt serve --synthetic`` and
     ``bench.py --serve`` (one definition, so the two artifacts measure the
-    same workload shape)."""
+    same workload shape).
+
+    ``shared_prefix_len > 0`` prepends the SAME random prefix to every
+    prompt — the system-prompt / few-shot-header workload the paged
+    layout's prefix cache exists for (requests after the first map those
+    leading pages instead of recomputing them)."""
     if n < 1:
         raise ValueError(f"need at least 1 request, got {n}")
     rng = np.random.default_rng(0) if rng is None else rng
     hi = max(min_prompt, max_prompt)
+    prefix: List[int] = (
+        rng.integers(1, vocab_size, shared_prefix_len).tolist()
+        if shared_prefix_len > 0
+        else []
+    )
     return [
         Request(
             uid=f"req{i}",
-            prompt=rng.integers(
+            prompt=prefix
+            + rng.integers(
                 1, vocab_size, rng.integers(min_prompt, hi + 1)
             ).tolist(),
         )
@@ -132,12 +173,19 @@ class ContinuousBatchingScheduler:
         *,
         eos_id: Optional[int] = None,
         max_new_tokens: int = 32,
+        step_cap: Optional[int] = None,
     ):
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if step_cap is not None and step_cap < 1:
+            raise ValueError("step_cap must be >= 1")
         self.engine = engine
         self.eos_id = eos_id
         self.max_new_tokens = max_new_tokens
+        # hard decode-step budget for smoke runs: when hit, active slots
+        # complete as "step_cap" and unstarted requests as "cancelled",
+        # so a scheduler/allocator regression can never hang CI
+        self.step_cap = step_cap
 
     def _finished(self, st: _SlotState) -> Optional[str]:
         if self.eos_id is not None and st.generated[-1] == self.eos_id:
@@ -159,6 +207,10 @@ class ContinuousBatchingScheduler:
         """
         engine = self.engine
         slots = engine.batch_slots
+        chunked = getattr(engine, "chunked_prefill", False)
+        # duck-typed engines (test fakes) may not implement the release
+        # verb; dense engines no-op it anyway
+        release = getattr(engine, "release", lambda _slot: None)
         pending = deque(requests)
         for r in pending:
             # explicit None-check: a falsy 0 must not silently inherit the
@@ -170,10 +222,13 @@ class ContinuousBatchingScheduler:
                     f"got {r.max_new_tokens}"
                 )
         n_requests = len(pending)
+        compiles_before = getattr(engine, "prefill_compiles", 0)
         t_start = time.perf_counter()
 
         active: Dict[int, _SlotState] = {}
         free = list(range(slots))
+        # in-flight chunked prefills: (task, req, budget, queue_wait_s)
+        prefilling: deque = deque()
         tokens_buf = np.zeros(slots, np.int32)
         pos_buf = np.zeros(slots, np.int32)
         results: List[CompletedRequest] = []
@@ -183,6 +238,13 @@ class ContinuousBatchingScheduler:
         finish_reasons: Dict[str, int] = {}
 
         error_count = 0
+
+        def budget_of(req: Request) -> int:
+            return (
+                req.max_new_tokens
+                if req.max_new_tokens is not None
+                else self.max_new_tokens
+            )
 
         def complete(
             slot: int, st: _SlotState, reason: str,
@@ -199,19 +261,24 @@ class ContinuousBatchingScheduler:
                     ttft_s=st.ttft_s,
                     total_s=round(now - t_start, 6),
                     error=error,
+                    queue_wait_s=st.queue_wait_s,
                 )
             )
             finish_reasons[reason] = finish_reasons.get(reason, 0) + 1
             if reason == "error":
                 error_count += 1
             del active[slot]
+            release(slot)  # paged: pages back to the pool
             free.append(slot)
 
-        def fail_request(req: Request, exc: BaseException) -> None:
+        def fail_request(
+            req: Request, exc: Optional[BaseException],
+            queue_wait: float = 0.0, reason: str = "error",
+        ) -> None:
             """Per-request fault isolation: record the failure, keep serving.
 
-            The slot was never (successfully) written, so it goes straight
-            back to the free list — the remaining traffic is unaffected.
+            The slot (if any) was already released by the caller, so the
+            remaining traffic is unaffected.
             """
             nonlocal error_count
             results.append(
@@ -219,43 +286,117 @@ class ContinuousBatchingScheduler:
                     uid=req.uid,
                     prompt_len=len(req.prompt),
                     tokens=[],
-                    finish_reason="error",
+                    finish_reason=reason,
                     ttft_s=0.0,
                     total_s=round(time.perf_counter() - t_start, 6),
-                    error=f"{type(exc).__name__}: {exc}",
+                    error=(
+                        f"{type(exc).__name__}: {exc}"
+                        if exc is not None
+                        else None
+                    ),
+                    queue_wait_s=queue_wait,
                 )
             )
-            finish_reasons["error"] = finish_reasons.get("error", 0) + 1
-            error_count += 1
+            finish_reasons[reason] = finish_reasons.get(reason, 0) + 1
+            if reason == "error":
+                error_count += 1
 
-        while pending or active:
+        capped = False
+        while pending or active or prefilling:
             # Admit prompts into free slots — mid-flight: slots released in
             # the previous iteration take new work while the rest decode on.
+            # Paged engines additionally gate on free PAGES: a request that
+            # could strand mid-decode is left queued (backpressure) until
+            # completions free its reservation.
             while pending and free:
-                req = pending.popleft()
+                req = pending[0]
+                budget = budget_of(req)
+                if chunked:
+                    if not engine.fits(len(req.prompt), budget):
+                        # exceeds the POOL — waiting can never admit it
+                        pending.popleft()
+                        prompt_tokens += len(req.prompt)
+                        fail_request(req, RuntimeError(
+                            f"request needs "
+                            f"{engine.required_pages(len(req.prompt), budget)}"
+                            f" pages, pool holds {engine.num_pages}"
+                        ))
+                        continue
+                    if not engine.can_admit(len(req.prompt), budget):
+                        if active or prefilling:
+                            break  # completions will free pages
+                        # nothing in flight can free pages: fail loudly
+                        # instead of spinning forever
+                        pending.popleft()
+                        prompt_tokens += len(req.prompt)
+                        fail_request(req, RuntimeError(
+                            "page pool exhausted with no requests in "
+                            "flight (pages leaked?)"
+                        ))
+                        continue
+                pending.popleft()
                 slot = free.pop()
                 prompt_tokens += len(req.prompt)
+                queue_wait = round(time.perf_counter() - t_start, 6)
+                if chunked:
+                    try:
+                        task = engine.prefill_begin(slot, req.prompt, budget)
+                    except Exception as exc:  # noqa: BLE001 — per-request
+                        release(slot)
+                        fail_request(req, exc, queue_wait)
+                        free.append(slot)
+                        continue
+                    prefilling.append((task, req, budget, queue_wait))
+                    continue
                 try:
                     first = engine.prefill(slot, req.prompt)
                 except Exception as exc:  # noqa: BLE001 — isolate per request
-                    fail_request(req, exc)
+                    fail_request(req, exc, queue_wait)
                     free.append(slot)
                     continue
                 st = _SlotState(
                     req=req,
-                    budget=(
-                        req.max_new_tokens
-                        if req.max_new_tokens is not None
-                        else self.max_new_tokens
-                    ),
+                    budget=budget,
                     generated=[first],
                     next_pos=len(req.prompt),
                     ttft_s=round(time.perf_counter() - t_start, 6),
+                    queue_wait_s=queue_wait,
                 )
                 active[slot] = st
                 reason = self._finished(st)
                 if reason is not None:  # EOS straight out of prefill
                     complete(slot, st, reason)
+
+            # Advance ONE chunk of the oldest in-flight prefill, then fall
+            # through to decode — the chunked-prefill interleave: running
+            # requests stall at most one chunk's compute per step, not a
+            # whole O(P²) prompt pass.
+            if prefilling:
+                task, req, budget, queue_wait = prefilling[0]
+                try:
+                    first = engine.prefill_step(task)
+                except Exception as exc:  # noqa: BLE001 — per-request
+                    prefilling.popleft()
+                    release(task.slot)
+                    fail_request(req, exc, queue_wait)
+                    free.append(task.slot)
+                else:
+                    if first is not None:  # final chunk landed
+                        prefilling.popleft()
+                        st = _SlotState(
+                            req=req,
+                            budget=budget,
+                            generated=[first],
+                            next_pos=len(req.prompt),
+                            ttft_s=round(
+                                time.perf_counter() - t_start, 6
+                            ),
+                            queue_wait_s=queue_wait,
+                        )
+                        active[task.slot] = st
+                        reason = self._finished(st)
+                        if reason is not None:
+                            complete(task.slot, st, reason)
 
             if not active:
                 continue
@@ -287,6 +428,25 @@ class ContinuousBatchingScheduler:
                 if reason is not None:
                     complete(slot, st, reason)
 
+            if self.step_cap is not None and len(step_times) >= self.step_cap:
+                capped = True
+                break
+
+        if capped:
+            # deadline semantics for smoke runs: everything still running
+            # or queued is accounted for, nothing hangs
+            for slot, st in list(active.items()):
+                complete(slot, st, "step_cap")
+            while prefilling:
+                task, req, budget, queue_wait = prefilling.popleft()
+                release(task.slot)
+                free.append(task.slot)
+                fail_request(req, None, queue_wait, reason="cancelled")
+            while pending:
+                req = pending.popleft()
+                prompt_tokens += len(req.prompt)
+                fail_request(req, None, reason="cancelled")
+
         wall = time.perf_counter() - t_start
         generated = sum(len(r.tokens) for r in results)
         report = ServeReport(
@@ -304,5 +464,26 @@ class ContinuousBatchingScheduler:
             ),
             finish_reasons=finish_reasons,
             errors=error_count,
+            queue_wait_s=_percentiles(
+                [r.queue_wait_s for r in results if r.finish_reason
+                 not in ("cancelled",)]
+            ),
+            prefill_compiles=(
+                getattr(engine, "prefill_compiles", 0) - compiles_before
+            ),
+            kv_layout=getattr(engine, "kv_layout", "dense"),
+            prefix_hit_rate=(
+                round(engine.prefix_hit_rate(), 4)
+                if hasattr(engine, "prefix_hit_rate")
+                else 0.0
+            ),
+            kv_bytes=(
+                engine.kv_bytes() if hasattr(engine, "kv_bytes") else 0
+            ),
+            kv_bytes_peak=(
+                engine.kv_bytes_peak()
+                if hasattr(engine, "kv_bytes_peak")
+                else 0
+            ),
         )
         return results, report
