@@ -1078,6 +1078,203 @@ def _run_faults(args) -> int:
     return 0 if line["completed_exact"] and faulted.returncode == 0 else 1
 
 
+def _run_comms(args) -> int:
+    """Gradient-communication benchmark: the explicit comm_overlap schedule
+    (``parallel/comms.py`` — bucketed reduce-scatter in the accumulation
+    scan, optional ZeRO weight-update sharding, optional bf16 compressed
+    wire) against the implicit-GSPMD baseline ON THE SAME MODEL.
+
+    Emits the ``COMMS_r09.json`` artifact: per-mode step time, per-step
+    bytes-on-wire (both the analytic ring model and the compiled-HLO
+    collective signature — the platform-independent, quotable half), and
+    overlap efficiency = exposed-comms / total-comms, where exposed is the
+    comm time the overlapped schedule fails to hide (its step time minus a
+    collective-elided ``comm_skip`` build of the same program) and total is
+    the implicit baseline's serialized comm time measured the same way.
+    On a virtual CPU pod wall-clock overlap is an artifact of host-core
+    contention (flagged via ``platform``/``virtual_pod``); the HLO byte
+    table is the part that transfers to hardware.
+    """
+    import time as _time
+
+    import jax
+
+    from distributeddeeplearning_tpu.train.state import create_train_state
+    from distributeddeeplearning_tpu.train.step import build_train_step
+    from distributeddeeplearning_tpu.utils.virtual_pod import (
+        force_cpu_platform_if_virtual_pod,
+        is_reexec_child,
+        reexec_with_virtual_pod,
+    )
+
+    force_cpu_platform_if_virtual_pod()
+    if len(jax.devices()) < 2:
+        # both modes on a CPU mesh: the comparison needs real data-parallel
+        # shards, so fake an 8-chip pod (same recipe as --devices)
+        return reexec_with_virtual_pod(8)
+
+    import jax.numpy as jnp
+
+    step0, state0, batch, n_dev, (mesh, model, tx, init_shape, init_kw) = (
+        _build_bench(args)
+    )
+    dtype = jnp.float32 if args.fp32 else jnp.bfloat16
+    accum = args.accum_steps
+    smoke = args.steps_cap is not None
+    warmup_steps = 1 if smoke else 3
+    timed_steps = args.steps_cap if smoke else 10
+
+    def fresh_state(seed):
+        return create_train_state(
+            jax.random.key(seed), model, init_shape, tx, **init_kw
+        )
+
+    def build(seed, **comm_kwargs):
+        state = fresh_state(seed)
+        step = build_train_step(
+            mesh, state, compute_dtype=dtype, accum_steps=accum,
+            **comm_kwargs,
+        )
+        if comm_kwargs.get("comm_overlap"):
+            state = step.prepare_state(state)
+        return step, state
+
+    def measure(step, state):
+        """(seconds/step, collective HLO stats, wire-model dict|None)."""
+        compiled = step.lower(state, batch).compile()
+        coll = _collective_stats(compiled.as_text())
+        metrics = None
+        for _ in range(warmup_steps):
+            state, metrics = compiled(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        t0 = _time.perf_counter()
+        for _ in range(timed_steps):
+            state, metrics = compiled(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        per_step = (_time.perf_counter() - t0) / timed_steps
+        wire = step.wire_bytes() if hasattr(step, "wire_bytes") else None
+        return per_step, coll, wire
+
+    all_modes = {
+        "implicit": {},
+        "overlap": dict(comm_overlap=True, bucket_mb=args.bucket_mb),
+        "overlap_wus": dict(
+            comm_overlap=True, bucket_mb=args.bucket_mb,
+            weight_update_sharding=True,
+        ),
+        "overlap_bf16": dict(
+            comm_overlap=True, bucket_mb=args.bucket_mb, comm_dtype="bf16",
+        ),
+    }
+    selected = [m.strip() for m in args.comms_modes.split(",") if m.strip()]
+    unknown = [m for m in selected if m not in all_modes]
+    if unknown or not {"implicit", "overlap"} <= set(selected):
+        print(
+            f"[comms] --comms-modes must include implicit,overlap and only "
+            f"draw from {sorted(all_modes)} (got {selected})",
+            file=sys.stderr,
+        )
+        return 2
+    modes = {name: all_modes[name] for name in all_modes if name in selected}
+    del step0, state0  # rebuilt below: every mode (implicit included) must
+    # compile with the SAME accum_steps or the step-time ratio would
+    # compare different microbatching schedules
+    rows = {}
+    for i, (name, kwargs) in enumerate(modes.items()):
+        step, state = build(i + 1, **kwargs)
+        per_step, coll, wire = measure(step, state)
+        rows[name] = {
+            "step_time_s": round(per_step, 5),
+            "collectives_per_step": coll,
+            "hlo_collective_bytes_per_step": sum(
+                s["bytes"] for s in coll.values()
+            ),
+        }
+        if wire:
+            rows[name]["ring_wire_bytes_per_step_per_device"] = wire
+        print(
+            f"[comms] {name}: {per_step * 1e3:.1f} ms/step, "
+            f"{rows[name]['hlo_collective_bytes_per_step']} HLO collective "
+            "bytes/step",
+            file=sys.stderr,
+        )
+
+    # collective-elided twin of the overlap program: its step time is the
+    # pure compute cost, the subtrahend of both comm-time estimates
+    nc_step, nc_state = build(
+        9, comm_overlap=True, bucket_mb=args.bucket_mb, comm_skip=True
+    )
+    t_compute, _, _ = measure(nc_step, nc_state)
+    t_base = rows["implicit"]["step_time_s"]
+    eps = 1e-9
+    for name in rows:
+        if name == "implicit":
+            continue
+        # clamped at eps so the documented (0, 1] range holds even when
+        # CPU-contention noise makes the compute-only twin measure slower
+        # than the mode itself
+        exposed = max(rows[name]["step_time_s"] - t_compute, eps)
+        # total serialized comm time, from the implicit baseline; clamped
+        # to >= exposed so the ratio stays in (0, 1] when CPU-contention
+        # noise makes the compute-only twin slower than the whole GSPMD
+        # program (ratio 1.0 then reads "no overlap demonstrated" — the
+        # honest verdict for a virtual pod)
+        total = max(t_base - t_compute, exposed, eps)
+        rows[name]["exposed_comms_s_per_step"] = round(exposed, 5)
+        rows[name]["total_comms_s_per_step"] = round(total, 5)
+        rows[name]["overlap_efficiency"] = round(exposed / total, 4)
+
+    # the compressed-wire claim comes from the ring model (analytic, so it
+    # never depends on which modes ran): XLA backends without native bf16
+    # reduction (CPU) promote the collective to f32 in HLO, and in-scan
+    # reduce-scatters appear once in program text but execute accum_steps
+    # times — the analytic table prices the actual wire schedule
+    from distributeddeeplearning_tpu.parallel import comms as comms_mod
+
+    layout = nc_step.layout
+    rs_f32 = comms_mod.ring_wire_bytes(
+        layout, comm_dtype=None, accum_steps=accum
+    )["reduce_scatter_bytes"]
+    rs_bf16 = comms_mod.ring_wire_bytes(
+        layout, comm_dtype=jnp.bfloat16, accum_steps=accum
+    )["reduce_scatter_bytes"]
+    line = {
+        "metric": f"{args.model}_comm_overlap_vs_implicit_step_time_ratio",
+        "value": round(rows["overlap"]["step_time_s"] / max(t_base, eps), 4),
+        "unit": "x",
+        "vs_baseline": None,
+        "modes": rows,
+        "compute_only_step_time_s": round(t_compute, 5),
+        "compressed_vs_f32_wire_ratio": (
+            round(rs_bf16 / rs_f32, 4) if rs_f32 else None
+        ),
+        "hlo_caveat": (
+            "collectives_per_step sums program TEXT: in-scan reduce-"
+            "scatters execute accum_steps times per step, and backends "
+            "without native bf16 reduction (CPU) promote compressed "
+            "collectives to f32 in HLO — ring_wire_bytes_per_step_per_"
+            "device prices the schedule as specified"
+        ),
+        "bucket_mb": args.bucket_mb,
+        "accum_steps": accum,
+        "num_devices": n_dev,
+        "batch_size_per_chip": args.batch_size,
+        "wall_clock_caveat": (
+            "virtual-pod CPU wall clock measures host-core contention, not "
+            "ICI overlap; the HLO collective table is the portable half"
+        ) if is_reexec_child() or _is_virtual_pod() else None,
+        "platform": jax.default_backend(),
+        "virtual_pod": _is_virtual_pod(),
+    }
+    print(json.dumps(line))
+    report_path = args.report or "COMMS_r09.json"
+    with open(report_path, "w") as f:
+        json.dump(line, f, indent=2)
+        f.write("\n")
+    print(f"[comms] report -> {report_path}", file=sys.stderr)
+    return 0
+
+
 _COLLECTIVE_OPS = (
     "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
     "all-to-all",
@@ -1373,9 +1570,38 @@ def main() -> int:
         "--steps-cap",
         type=int,
         default=None,
-        help="hard decode-step budget for --serve smoke runs: warmup is "
-        "skipped, active requests complete as 'step_cap', queued ones as "
-        "'cancelled' — a scheduler/allocator regression can never hang CI",
+        help="hard step budget for smoke runs: --serve skips warmup and "
+        "caps decode steps (active requests complete as 'step_cap', queued "
+        "as 'cancelled'); --comms times exactly this many steps with "
+        "minimal warmup — a regression can never hang CI",
+    )
+    parser.add_argument(
+        "--comms",
+        action="store_true",
+        help="benchmark the explicit gradient-comms schedule "
+        "(parallel/comms.py: bucketed reduce-scatter overlap, weight-"
+        "update sharding, bf16 compressed wire) against the implicit "
+        "GSPMD allreduce on the same model; emits COMMS_r09.json",
+    )
+    parser.add_argument(
+        "--bucket-mb",
+        type=float,
+        default=4.0,
+        help="gradient bucket size in MB for --comms overlap modes",
+    )
+    parser.add_argument(
+        "--accum-steps",
+        type=int,
+        default=2,
+        help="microbatch accumulation for --comms (the overlap schedule "
+        "reduce-scatters per microbatch inside the scan; >1 exercises it)",
+    )
+    parser.add_argument(
+        "--comms-modes",
+        default="implicit,overlap,overlap_wus,overlap_bf16",
+        help="comma subset of comms modes to run (must include "
+        "implicit,overlap); CI smokes trim compile time with "
+        "implicit,overlap",
     )
     parser.add_argument(
         "--faults",
@@ -1437,6 +1663,19 @@ def main() -> int:
         parser.error("--serve and --devices are mutually exclusive")
     if args.faults and (args.serve or args.devices or args.data):
         parser.error("--faults is exclusive with --serve/--devices/--data")
+    if args.comms:
+        if args.serve or args.devices or args.data or args.faults:
+            parser.error(
+                "--comms is exclusive with --serve/--devices/--data/--faults"
+            )
+        if args.model.startswith("bert") or args.model == "lm":
+            # bert's adamw chains clip_by_global_norm (invalid under
+            # weight-update sharding — shard-norm clipping) and the lm
+            # builder hand-rolls its TrainState; the image models are the
+            # comparison the artifact documents
+            parser.error("--comms supports the image models (e.g. resnet50)")
+        if args.steps_cap is not None and args.steps_cap < 1:
+            parser.error("--steps-cap must be >= 1 with --comms")
 
     if args.small:
         args.batch_size, args.image_size = 16, 64
@@ -1497,6 +1736,8 @@ def main() -> int:
     enable_compilation_cache()
     if args.faults:
         return _run_faults(args)
+    if args.comms:
+        return _run_comms(args)
     if args.devices:
         return _run_scaling(args)
     if args.serve:

@@ -9,6 +9,7 @@ collectives directly onto ICI within a pod slice and DCN across slices, and
 ``jax.distributed.initialize`` replaces the mpirun rendezvous.
 """
 
+from distributeddeeplearning_tpu.parallel import comms
 from distributeddeeplearning_tpu.parallel.mesh import (
     MeshSpec,
     create_mesh,
@@ -30,6 +31,7 @@ from distributeddeeplearning_tpu.parallel.distributed import (
 )
 
 __all__ = [
+    "comms",
     "MeshSpec",
     "create_mesh",
     "local_device_count",

@@ -504,15 +504,18 @@ class Trainer:
                 if detector is not None:
                     # One host sync per step — the price of reacting to a
                     # diverging run before it wastes the rest of the epoch.
-                    loss_v = float(metrics["loss"])
+                    # (sync-ok markers: the hot-loop lint in
+                    # tests/test_hotloop_lint.py allowlists exactly these
+                    # lines; any NEW per-step host sync fails tier-1.)
+                    loss_v = float(metrics["loss"])  # sync-ok: anomaly detector
                     gn = metrics.get("grad_norm")
                     flagged = metrics.get("anomalous")
                     try:
                         anomalous = detector.observe(
                             true_step, loss_v,
-                            float(gn) if gn is not None else None,
+                            float(gn) if gn is not None else None,  # sync-ok: anomaly detector
                             flagged=(
-                                bool(float(flagged))
+                                bool(float(flagged))  # sync-ok: anomaly detector
                                 if flagged is not None else None
                             ),
                         )

@@ -33,6 +33,9 @@ from distributeddeeplearning_tpu.parallel.sharding import (
 PyTree = Any
 Metrics = Dict[str, jax.Array]
 
+COMM_DTYPES = {None: None, "f32": None, "float32": None,
+               "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16}
+
 
 def cross_entropy_loss(
     logits: jax.Array, labels: jax.Array, *, label_smoothing: float = 0.0
@@ -144,15 +147,50 @@ def _state_shardings(mesh, state_example, rules, logical_axes):
         # graft the full param-sharding tree over params-shaped subtrees
         return p_shard if params_like(subtree) else r_shard
 
-    opt_shardings = jax.tree_util.tree_map(
-        opt_leaf, state_example.opt_state, is_leaf=params_like
-    )
+    opt_example = state_example.opt_state
+    if isinstance(opt_example, dict) and set(opt_example) == {"base", "residual"}:
+        # comm-overlap layout (parallel/comms.py): per-bucket flat shards
+        # (bare tuples of 1-D arrays) stay physically sharded over the
+        # data axes — an eval step built from a prepared state must not
+        # force-replicate the distributed optimizer buffers it never reads
+        opt_shardings = _comm_opt_shardings(mesh, opt_example)
+    else:
+        opt_shardings = jax.tree_util.tree_map(
+            opt_leaf, opt_example, is_leaf=params_like
+        )
     return state_example.replace(
         step=r_shard,
         params=p_shard,
         opt_state=opt_shardings,
         batch_stats=jax.tree_util.tree_map(lambda _: r_shard, state_example.batch_stats),
     )
+
+
+def _comm_opt_shardings(mesh, opt_state):
+    """Shardings for a comm-overlap ``{"base", "residual"}`` opt_state:
+    per-bucket flat vectors (the WUS optimizer shards and the compression
+    residual) over the data axes, everything else replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
+
+    r = replicated(mesh)
+    s = NamedSharding(mesh, P(DATA_AXES))
+
+    def is_bucket_tuple(x):
+        return (
+            type(x) is tuple and len(x) > 0
+            and all(getattr(e, "ndim", None) == 1 for e in x)
+        )
+
+    base = jax.tree_util.tree_map(
+        lambda x: tuple(s for _ in x) if is_bucket_tuple(x) else r,
+        opt_state["base"], is_leaf=is_bucket_tuple,
+    )
+    return {
+        "base": base,
+        "residual": tuple(s for _ in opt_state["residual"]),
+    }
 
 
 def build_train_step(
@@ -171,6 +209,11 @@ def build_train_step(
     accum_steps: int = 1,
     input_transform: Optional[Callable] = None,
     skip_nonfinite: bool = False,
+    comm_overlap: bool = False,
+    bucket_mb: float = 4.0,
+    comm_dtype: Optional[Any] = None,
+    weight_update_sharding: bool = False,
+    comm_skip: bool = False,
 ) -> Callable:
     """Compile the full DP training step over ``mesh``.
 
@@ -210,9 +253,79 @@ def build_train_step(
     Trainer's ``AnomalyDetector`` consumes.  Off by default: the extra
     select is cheap but not free, and perf-critical runs should compile the
     identical program they always did.
+
+    ``comm_overlap`` replaces the implicit post-backward GSPMD allreduce
+    with the explicit schedule in ``parallel/comms.py``: gradients are
+    flattened into fixed-size buckets (``bucket_mb``) and each bucket's
+    reduce-scatter over the data axes is issued as soon as that
+    microbatch's grads exist inside the accumulation scan — wire time
+    overlaps the next microbatch's backward instead of serializing after
+    it.  ``weight_update_sharding`` (ZeRO-style distributed optimizer for
+    the replicated-params path) applies the optimizer to each chip's 1/N
+    gradient shard only and all-gathers the updated params, cutting
+    optimizer FLOPs and params-shaped optimizer HBM (momentum, Adam m/v)
+    by the data-parallel degree; it assumes the optimizer transform is
+    elementwise given (grads, state, params) — SGD/momentum/Adam qualify,
+    ``optax.clip_by_global_norm`` does NOT (it would clip by the shard
+    norm).  ``comm_dtype="bf16"`` halves wire bytes by compressing the
+    reduce-scatter payload, with per-bucket f32 error-feedback residuals
+    carried in the train state (and checkpointed) so the rounding error
+    re-enters the next step's reduction instead of being lost.
+
+    The comm_overlap path requires replicated params (pure DP — no
+    ``rules``/``logical_axes``), and its returned step carries a
+    ``prepare_state`` method that converts a fresh ``TrainState`` into the
+    comm layout (flat-sharded optimizer buffers + residual slot) — call it
+    once before the first step (and use the prepared state as the restore
+    template).  ``comm_skip`` is a benchmarking-only debug knob that
+    elides the collectives (numerics are garbage) so ``bench.py --comms``
+    can price the compute-only step.
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if comm_overlap:
+        if rules or logical_axes is not None:
+            raise ValueError(
+                "comm_overlap is the explicit replicated-params (pure DP) "
+                "schedule; FSDP/TP models keep the implicit GSPMD path "
+                "(drop rules/logical_axes or comm_overlap)"
+            )
+        if comm_dtype not in COMM_DTYPES and comm_dtype is not jnp.bfloat16:
+            raise ValueError(
+                f"comm_dtype must be one of "
+                f"{sorted(k for k in COMM_DTYPES if k)} or None, "
+                f"got {comm_dtype!r}"
+            )
+        return _build_comm_overlap_step(
+            mesh,
+            state_example,
+            compute_dtype=compute_dtype,
+            label_smoothing=label_smoothing,
+            schedule=schedule,
+            loss_fn=loss_fn,
+            metrics_fn=metrics_fn,
+            rng=rng,
+            moe_aux_weight=moe_aux_weight,
+            accum_steps=accum_steps,
+            input_transform=input_transform,
+            skip_nonfinite=skip_nonfinite,
+            bucket_mb=bucket_mb,
+            comm_dtype=(
+                jnp.bfloat16 if comm_dtype is jnp.bfloat16
+                else COMM_DTYPES[comm_dtype]
+            ),
+            weight_update_sharding=weight_update_sharding,
+            comm_skip=comm_skip,
+        )
+    if weight_update_sharding or comm_skip or comm_dtype not in (
+        None, "f32", "float32"
+    ):
+        # silently dropping these would let an A/B run believe it measured
+        # the explicit schedule while compiling the implicit one
+        raise ValueError(
+            "weight_update_sharding/comm_skip/comm_dtype require "
+            "comm_overlap=True"
+        )
     b_shard = batch_sharding(mesh)
     r_shard = replicated(mesh)
     state_shardings = _state_shardings(mesh, state_example, rules or [], logical_axes)
@@ -345,6 +458,345 @@ def build_train_step(
         in_shardings=(state_shardings, b_shard),
         out_shardings=(state_shardings, r_shard),
         donate_argnums=(0,),
+    )
+
+
+class CommOverlapStep:
+    """The compiled ``comm_overlap`` train step.
+
+    Callable exactly like the plain jitted step (``step(state, batch)``,
+    ``step.lower(...)``), plus the comm-layout plumbing callers need:
+    ``prepare_state`` converts a fresh ``TrainState`` into the layout this
+    step trains and checkpoints (flat-sharded optimizer buffers under
+    weight-update sharding, the bf16 error-feedback residual slot), and
+    ``wire_bytes()`` reports the analytic per-device bytes-on-wire model
+    for the bench artifact.
+    """
+
+    def __init__(self, jitted, mesh, layout, *, comm_dtype,
+                 weight_update_sharding, accum_steps):
+        self._jitted = jitted
+        self.mesh = mesh
+        self.layout = layout
+        self.comm_dtype = comm_dtype
+        self.weight_update_sharding = weight_update_sharding
+        self.accum_steps = accum_steps
+        self.comm_overlap = True
+
+    def __call__(self, state, batch):
+        return self._jitted(state, batch)
+
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+    def prepare_state(self, state):
+        from distributeddeeplearning_tpu.parallel import comms
+
+        return comms.prepare_comm_state(
+            self.mesh, state, self.layout,
+            weight_update_sharding=self.weight_update_sharding,
+            comm_dtype=self.comm_dtype,
+        )
+
+    def wire_bytes(self) -> Dict[str, int]:
+        from distributeddeeplearning_tpu.parallel import comms
+
+        return comms.ring_wire_bytes(
+            self.layout, comm_dtype=self.comm_dtype,
+            weight_update_sharding=self.weight_update_sharding,
+            accum_steps=self.accum_steps,
+        )
+
+
+def _build_comm_overlap_step(
+    mesh,
+    state_example,
+    *,
+    compute_dtype,
+    label_smoothing,
+    schedule,
+    loss_fn,
+    metrics_fn,
+    rng,
+    moe_aux_weight,
+    accum_steps,
+    input_transform,
+    skip_nonfinite,
+    bucket_mb,
+    comm_dtype,
+    weight_update_sharding,
+    comm_skip,
+) -> CommOverlapStep:
+    """The explicit-comms train step: shard_map over the data axes with
+    bucketed reduce-scatter inside the accumulation scan, optional ZeRO
+    weight-update sharding, optional bf16 wire compression with error
+    feedback.  See ``build_train_step``'s docstring for semantics and
+    ``parallel/comms.py`` for the collectives."""
+    import types
+
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributeddeeplearning_tpu.parallel import comms
+    from distributeddeeplearning_tpu.parallel.mesh import (
+        DATA_AXES,
+        data_parallel_size,
+    )
+
+    n_shards = data_parallel_size(mesh)
+    fsdp_size = mesh.shape["fsdp"]
+    layout = comms.BucketLayout.for_tree(
+        state_example.params,
+        bucket_bytes=max(int(bucket_mb * 2**20), 4),
+        shards=n_shards,
+    )
+    b_shard = batch_sharding(mesh)
+    r_shard = replicated(mesh)
+    shard_over_data = NamedSharding(mesh, P(DATA_AXES))
+    p_treedef = jax.tree_util.tree_structure(state_example.params)
+    base_rng = rng if rng is not None else jax.random.key(0)
+    AX = DATA_AXES
+    tx = state_example.tx
+    apply_fn = state_example.apply_fn
+    has_stats = bool(jax.tree_util.tree_leaves(state_example.batch_stats))
+    # _forward only touches static attrs (apply_fn) when batch_stats is
+    # passed explicitly; a namespace shim keeps the outer traced state out
+    # of the shard_map body (its arrays enter as explicit arguments).
+    fwd_shim = types.SimpleNamespace(apply_fn=apply_fn, batch_stats={})
+
+    opt_shardings = comms.comm_opt_specs(
+        state_example.opt_state, p_treedef, layout,
+        weight_update_sharding=weight_update_sharding,
+        spec_sharded=shard_over_data, spec_replicated=r_shard,
+    )
+    opt_specs = comms.comm_opt_specs(
+        state_example.opt_state, p_treedef, layout,
+        weight_update_sharding=weight_update_sharding,
+        spec_sharded=P(AX), spec_replicated=P(),
+    )
+    n_buckets = layout.num_buckets
+    residual_shardings = (
+        tuple(shard_over_data for _ in range(n_buckets))
+        if comm_dtype is not None else ()
+    )
+    residual_specs = (
+        tuple(P(AX) for _ in range(n_buckets)) if comm_dtype is not None else ()
+    )
+    state_shardings = state_example.replace(
+        step=r_shard,
+        params=jax.tree_util.tree_map(lambda _: r_shard, state_example.params),
+        opt_state={"base": opt_shardings, "residual": residual_shardings},
+        batch_stats=jax.tree_util.tree_map(
+            lambda _: r_shard, state_example.batch_stats
+        ),
+    )
+
+    def step_fn(state, batch):
+        inputs = batch.get("image", batch.get("input"))
+        if input_transform is not None:
+            inputs = input_transform(inputs)
+        labels = batch["label"]
+        extras = {k: batch[k] for k in EXTRA_INPUT_KEYS if k in batch}
+        if inputs.shape[0] % (n_shards * accum_steps):
+            raise ValueError(
+                f"global batch {inputs.shape[0]} not divisible by "
+                f"data shards x accum_steps = {n_shards} x {accum_steps}"
+            )
+        step_rng = jax.random.fold_in(base_rng, state.step)
+        parts = {"inputs": inputs, "labels": labels, "extras": extras}
+        parts_spec = jax.tree_util.tree_map(lambda _: P(AX), parts)
+
+        def inner(params, opt_base, residuals, stats, key, data):
+            dev = (
+                lax.axis_index("data") * fsdp_size + lax.axis_index("fsdp")
+            )
+
+            def compute_loss(p, st, mb_inputs, mb_labels, mb_extras, rngs):
+                logits, new_stats, aux = _forward(
+                    fwd_shim, p, _cast_inputs(mb_inputs, compute_dtype),
+                    train=True, rngs=rngs, extras=mb_extras, batch_stats=st,
+                )
+                loss = loss_fn(
+                    logits, mb_labels, label_smoothing=label_smoothing
+                )
+                # Sown aux terms are global SUMS in the implicit path; the
+                # local partial scales by the shard count so psum/N of the
+                # gradients reproduces the same total.
+                loss = loss + moe_aux_weight * aux * n_shards
+                return loss, (logits, new_stats)
+
+            grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+
+            def scatter(grads, res):
+                buckets = layout.to_buckets(grads)
+                if comm_skip:
+                    return tuple(
+                        layout.shard_slice(b, dev) for b in buckets
+                    ), res
+                if comm_dtype is None:
+                    shards, _ = comms.reduce_scatter_buckets(buckets, AX)
+                    return shards, res
+                return comms.reduce_scatter_buckets(
+                    buckets, AX, comm_dtype=comm_dtype, residuals=res,
+                    shards=n_shards,
+                )
+
+            def gather(shards):
+                if comm_skip:  # timing-only: numerics are garbage
+                    return jnp.concatenate(
+                        [jnp.tile(s, n_shards) for s in shards]
+                    )
+                return comms.gather_flat(shards, AX)
+
+            if accum_steps == 1:
+                # straight value_and_grad — no scan wrapper, no zero
+                # accumulator (same minimal-program contract as the
+                # implicit path's accum_steps == 1 special case)
+                rngs = {"dropout": jax.random.fold_in(key, dev)}
+                (loss, (logits, new_stats)), grads = grad_fn(
+                    params, stats, data["inputs"], data["labels"],
+                    data["extras"], rngs,
+                )
+                g_shards, new_residuals = scatter(grads, residuals)
+                main_logits = logits[0] if isinstance(logits, tuple) else logits
+                local_metrics = metrics_fn(main_logits, data["labels"], loss)
+            else:
+                def split(x):
+                    # strided split of the LOCAL rows: local row l lands in
+                    # microbatch l % accum — with the batch contiguously
+                    # sharded over devices this reproduces the implicit
+                    # path's global strided microbatches device-for-device
+                    return x.reshape(
+                        (x.shape[0] // accum_steps, accum_steps) + x.shape[1:]
+                    ).swapaxes(0, 1)
+
+                micro = jax.tree_util.tree_map(split, data)
+                zero_shards = tuple(
+                    jnp.zeros((n // n_shards,), jnp.float32)
+                    for n in layout.bucket_sizes
+                )
+
+                def body(carry, xs):
+                    acc, res, st, i = carry
+                    rngs = {
+                        "dropout": jax.random.fold_in(
+                            jax.random.fold_in(key, i), dev
+                        )
+                    }
+                    (loss, (logits, st)), grads = grad_fn(
+                        params, st, xs["inputs"], xs["labels"], xs["extras"],
+                        rngs,
+                    )
+                    # the reduce-scatter of THIS microbatch's buckets sits
+                    # before the next iteration's backward in the dataflow:
+                    # async collective start/done overlaps the wire with
+                    # that compute, and the scan accumulates 1/N-sized
+                    # scattered shards instead of full gradient trees
+                    shards, res = scatter(grads, res)
+                    acc = tuple(a + s for a, s in zip(acc, shards))
+                    main_logits = (
+                        logits[0] if isinstance(logits, tuple) else logits
+                    )
+                    mb_metrics = metrics_fn(main_logits, xs["labels"], loss)
+                    return (acc, res, st, i + 1), mb_metrics
+
+                (g_shards, new_residuals, new_stats, _), mstack = lax.scan(
+                    body,
+                    (zero_shards, residuals, stats, jnp.zeros((), jnp.int32)),
+                    micro,
+                )
+                local_metrics = jax.tree_util.tree_map(
+                    lambda m: m.mean(axis=0), mstack
+                )
+
+            # psum_scatter summed over N shards; the implicit path's grads
+            # are the global-batch mean — one exact power-of-two rescale
+            # (when N and accum are powers of two) recovers it.
+            scale = 1.0 / (n_shards * accum_steps)
+            g_shards = tuple(s * scale for s in g_shards)
+
+            if weight_update_sharding:
+                # ZeRO: this chip updates only its 1/N flat param shard
+                # (optimizer buffers live as per-bucket flat shards in
+                # opt_base), then all-gathers the updated params.
+                p_buckets = layout.to_buckets(params)
+                p_shards = tuple(
+                    layout.shard_slice(b, dev) for b in p_buckets
+                )
+                updates, new_opt = tx.update(g_shards, opt_base, p_shards)
+                new_p_shards = optax.apply_updates(p_shards, updates)
+                new_params = layout.from_flat(gather(new_p_shards))
+            else:
+                grads_tree = layout.from_flat(gather(g_shards))
+                updates, new_opt = tx.update(grads_tree, opt_base, params)
+                new_params = optax.apply_updates(params, updates)
+
+            # ONE tree-level collective for metrics (+ BatchNorm stats,
+            # which under shard_map are per-device moments — averaged here,
+            # the reference's per-GPU-BN semantics rather than GSPMD's
+            # global-batch BN).
+            payload = {"metrics": local_metrics}
+            if has_stats:
+                payload["stats"] = new_stats
+            reduced = payload if comm_skip else lax.pmean(payload, AX)
+            metrics = dict(reduced["metrics"])
+            out_stats = reduced["stats"] if has_stats else new_stats
+
+            if skip_nonfinite:
+                sq = sum(
+                    jnp.sum(jnp.square(s)).astype(jnp.float32)
+                    for s in g_shards
+                )
+                grad_norm = jnp.sqrt(sq if comm_skip else lax.psum(sq, AX))
+                ok = jnp.isfinite(metrics["loss"]) & jnp.isfinite(grad_norm)
+
+                def keep(new, old):
+                    return jax.tree_util.tree_map(
+                        lambda a, b: jnp.where(ok, a, b), new, old
+                    )
+
+                new_params = keep(new_params, params)
+                new_opt = keep(new_opt, opt_base)
+                out_stats = keep(out_stats, stats)
+                if comm_dtype is not None:
+                    new_residuals = keep(new_residuals, residuals)
+                metrics["grad_norm"] = grad_norm.astype(jnp.float32)
+                metrics["anomalous"] = 1.0 - ok.astype(jnp.float32)
+
+            return new_params, new_opt, new_residuals, out_stats, metrics
+
+        inner_sm = shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(P(), opt_specs, residual_specs, P(), P(), parts_spec),
+            out_specs=(P(), opt_specs, residual_specs, P(), P()),
+            check_rep=False,
+        )
+        new_params, new_opt, new_res, new_stats, metrics = inner_sm(
+            state.params, state.opt_state["base"], state.opt_state["residual"],
+            state.batch_stats, step_rng, parts,
+        )
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            opt_state={"base": new_opt, "residual": new_res},
+            batch_stats=new_stats,
+        )
+        if schedule is not None:
+            metrics["lr"] = schedule(state.step).astype(jnp.float32)
+        return new_state, metrics
+
+    jitted = jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, b_shard),
+        out_shardings=(state_shardings, r_shard),
+        donate_argnums=(0,),
+    )
+    return CommOverlapStep(
+        jitted, mesh, layout, comm_dtype=comm_dtype,
+        weight_update_sharding=weight_update_sharding,
+        accum_steps=accum_steps,
     )
 
 

@@ -188,6 +188,11 @@ def main(
     metrics_path: Optional[str] = None,  # per-epoch JSONL rows (run.log_row)
     aux_logits: bool = False,  # InceptionV3 aux head, loss weighted 0.4
     num_slices: int = 1,  # multi-slice (DCN) data parallelism
+    # -- explicit gradient comms (parallel/comms.py; step.py docstrings) --
+    comm_overlap: bool = False,  # bucketed reduce-scatter overlap schedule
+    bucket_mb: float = 4.0,  # gradient bucket size for comm_overlap
+    comm_dtype: Optional[str] = None,  # "bf16" = compressed wire + error feedback
+    weight_update_sharding: bool = False,  # ZeRO distributed optimizer (pure DP)
     # -- resilience (train/resilience.py; see TrainerConfig docstrings) --
     skip_nonfinite: bool = False,  # in-step guard: discard non-finite updates
     anomaly_max_consecutive: Optional[int] = None,  # abort after N in a row
@@ -259,8 +264,16 @@ def main(
         mesh, state, schedule=schedule, label_smoothing=label_smoothing,
         compute_dtype=dtype, rng=jax.random.key(seed + 1),
         accum_steps=accum_steps, skip_nonfinite=skip_nonfinite,
+        comm_overlap=comm_overlap, bucket_mb=bucket_mb,
+        comm_dtype=comm_dtype,
+        weight_update_sharding=weight_update_sharding,
         **step_kwargs,
     )
+    if comm_overlap:
+        # flat-shard the optimizer buffers / add the residual slot; the
+        # prepared state is ALSO the checkpoint restore template, so
+        # resume round-trips the comm layout (residual included)
+        state = train_step.prepare_state(state)
     eval_step = build_eval_step(
         mesh, state, compute_dtype=dtype,
         input_transform=step_kwargs.get("input_transform"),

@@ -114,6 +114,12 @@ def main(
     # ring attention's blocked inner loop: bounds per-tick score memory at
     # O(Sq*block_k) — set for long-context launches (must divide S/seq)
     sp_block_k: Optional[int] = None,
+    # -- explicit gradient comms (parallel/comms.py; step.py docstrings);
+    # pure-DP geometry only (pipe/seq/fsdp/tensor all 1) --
+    comm_overlap: bool = False,  # bucketed reduce-scatter overlap schedule
+    bucket_mb: float = 4.0,  # gradient bucket size for comm_overlap
+    comm_dtype: Optional[str] = None,  # "bf16" = compressed wire + error feedback
+    weight_update_sharding: bool = False,  # ZeRO distributed optimizer
     # -- resilience (train/resilience.py; see TrainerConfig docstrings) --
     skip_nonfinite: bool = False,  # in-step guard: discard non-finite updates
     anomaly_max_consecutive: Optional[int] = None,  # abort after N in a row
@@ -196,6 +202,19 @@ def main(
             f"tensor={tensor} must divide d_model ({d_model}), "
             f"d_ff ({d_ff}) and num_heads ({num_heads})"
         )
+    if comm_overlap:
+        if pipe > 1 or seq > 1 or fsdp > 1 or tensor > 1:
+            raise ValueError(
+                "comm_overlap is the explicit replicated-params DP "
+                "schedule; it does not compose with pipe/seq/fsdp/tensor"
+            )
+        if weight_update_sharding and grad_clip_norm:
+            raise ValueError(
+                "weight_update_sharding applies the optimizer per gradient "
+                "shard, so optax.clip_by_global_norm would clip by the "
+                "SHARD norm — pass --grad_clip_norm 0 with "
+                "--weight_update_sharding"
+            )
     ctx = initialize(force=distributed)
     mesh = create_mesh(
         MeshSpec(pipe=pipe, seq=seq, fsdp=fsdp, tensor=tensor),
@@ -357,11 +376,20 @@ def main(
 
     train_step = build_train_step(
         mesh, state, schedule=schedule, compute_dtype=dtype,
-        rules=rules, logical_axes=logical_axes,
+        # comm_overlap is replicated-params only: the rules exist for the
+        # pipe/fsdp/tensor geometries this mode already excluded above
+        rules=None if comm_overlap else rules,
+        logical_axes=None if comm_overlap else logical_axes,
         loss_fn=lm_loss, metrics_fn=lm_metrics,
         rng=jax.random.key(seed + 1), accum_steps=accum_steps,
         skip_nonfinite=skip_nonfinite,
+        comm_overlap=comm_overlap, bucket_mb=bucket_mb,
+        comm_dtype=comm_dtype,
+        weight_update_sharding=weight_update_sharding,
     )
+    if comm_overlap:
+        # prepared state doubles as the checkpoint restore template
+        state = train_step.prepare_state(state)
     eval_step = build_eval_step(
         mesh, state, compute_dtype=dtype, rules=rules,
         logical_axes=logical_axes, loss_fn=lm_loss, metrics_fn=lm_metrics,

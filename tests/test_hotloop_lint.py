@@ -1,0 +1,76 @@
+"""Hot-loop host-sync lint — a tier-1 guard on dispatch pipelining.
+
+The trainer's throughput story depends on the step loop never blocking on
+device values: metrics accumulate on device and the host syncs only at the
+log interval (``train/loop.py``).  That property has been silently lost
+before (the r01 per-step ``float()`` cost ~2x) and nothing structural
+prevented it from regressing — so this lint greps the actual step-loop
+source for per-step host syncs (``float(``, ``.item()``, ``np.asarray``,
+``device_get``) and fails on any line not explicitly allow-listed with a
+``# sync-ok`` marker (today: the anomaly detector's documented
+one-sync-per-step price).  The jitted step builders are held to a stricter
+bar: no such token at all (inside jit they would either crash or silently
+fall back to host math).
+"""
+
+import inspect
+import re
+
+BANNED = re.compile(r"(?<![\w.])float\(|\.item\(\)|np\.asarray|device_get")
+MARKER = "sync-ok"
+
+
+def _step_loop_body():
+    """Source lines of the ``for step_i in range(...)`` hot loop inside
+    ``Trainer._fit_inner`` (by indentation, comments included)."""
+    from distributeddeeplearning_tpu.train.loop import Trainer
+
+    lines = inspect.getsource(Trainer._fit_inner).splitlines()
+    start = next(
+        i for i, line in enumerate(lines) if "for step_i in range" in line
+    )
+    indent = len(lines[start]) - len(lines[start].lstrip())
+    body = []
+    for line in lines[start + 1:]:
+        if line.strip() and (len(line) - len(line.lstrip())) <= indent:
+            break
+        body.append(line)
+    assert body, "could not locate the step loop body"
+    return body
+
+
+def test_trainer_step_loop_has_no_unmarked_host_sync():
+    offenders = [
+        line.strip()
+        for line in _step_loop_body()
+        if BANNED.search(line) and MARKER not in line
+    ]
+    assert not offenders, (
+        "per-step host sync in Trainer.fit's hot loop — this serializes "
+        "dispatch on every step.  Move it to the log-interval block, or if "
+        "it is a deliberate documented price (like the anomaly detector's "
+        f"per-step read) tag the line with '# {MARKER}':\n  "
+        + "\n  ".join(offenders)
+    )
+
+
+def test_trainer_step_loop_allowlist_is_alive():
+    """The lint must be exercising something: the anomaly detector's
+    documented sync lines carry the marker (if they move out of the loop,
+    update the lint's docstring story too)."""
+    body = _step_loop_body()
+    marked = [line for line in body if MARKER in line and BANNED.search(line)]
+    assert marked, "no allow-listed sync lines found — lint may be scanning the wrong region"
+
+
+def test_step_builders_have_no_host_sync_tokens():
+    from distributeddeeplearning_tpu.train import step as step_mod
+
+    for fn in (step_mod.build_train_step, step_mod._build_comm_overlap_step,
+               step_mod.build_eval_step):
+        for line in inspect.getsource(fn).splitlines():
+            code = line.split("#", 1)[0]
+            assert not BANNED.search(code), (
+                f"host-sync token inside jitted step builder "
+                f"{fn.__name__}: {line.strip()!r}"
+            )
