@@ -28,6 +28,13 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from distributeddeeplearning_tpu.quant.qtensor import (
+    dequantize_kv as _dq_kv,
+    qmatmul as _mm,
+    quantize_kv as _q_kv,
+    quantized_cache,
+)
+
 PyTree = Any
 
 
@@ -104,7 +111,7 @@ def block_apply(
     hd = d // num_heads
 
     h = _layer_norm(x, p["ln1"])
-    qkv = h @ p["qkv"]  # [b, s, 3d]
+    qkv = _mm(h, p["qkv"])  # [b, s, 3d]
     q, k, v = jnp.split(qkv, 3, axis=-1)
     kv = None
     if return_kv:
@@ -140,10 +147,10 @@ def block_apply(
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, d).astype(x.dtype)
     else:
         raise ValueError(f"unknown attention {attention!r}")
-    x = x + ctx @ p["proj"]
+    x = x + _mm(ctx, p["proj"])
 
     h = _layer_norm(x, p["ln2"])
-    x = x + jax.nn.gelu(h @ p["w_in"], approximate=False) @ p["w_out"]
+    x = x + _mm(jax.nn.gelu(_mm(h, p["w_in"]), approximate=False), p["w_out"])
     if return_kv:
         return x, kv
     return x
@@ -221,7 +228,7 @@ def forward(
         params["blocks"], x, num_heads=num_heads, attention=attention,
         attention_fn=attention_fn, remat=remat, unroll=unroll,
     )
-    return x @ params["head"]
+    return _mm(x, params["head"])
 
 
 def forward_prefill(
@@ -253,10 +260,10 @@ def forward_prefill(
 
     x, (k, v) = jax.lax.scan(body, x, params["blocks"])
     # scan stacks layer-major [L, b, s, h, hd]; the cache is slot-major
-    return x @ params["head"], jnp.moveaxis(k, 0, 1), jnp.moveaxis(v, 0, 1)
+    return _mm(x, params["head"]), jnp.moveaxis(k, 0, 1), jnp.moveaxis(v, 0, 1)
 
 
-def _block_decode(p, x, k_l, v_l, pos, *, num_heads: int):
+def _block_decode(p, x, k_l, v_l, pos, *, num_heads: int, k_s=None, v_s=None):
     """One block's single-token decode against its cache layer.
 
     ``x``: [B, d] residual stream for the current token of every slot;
@@ -266,34 +273,55 @@ def _block_decode(p, x, k_l, v_l, pos, *, num_heads: int):
     position — slots decode at unequal depths under continuous batching),
     then attention runs dense against positions ``<= pos``.  Exactly
     :func:`block_apply`'s math restricted to one query row.
+
+    ``k_s``/``v_s`` ([B, S, h] f32, int8 cache only): per-position-per-
+    head scales.  The new token's K/V quantize on write (values + their
+    own scales), and attention reads the DEQUANTIZED view — the multiply
+    fuses into the score/context einsums, so the int8 cache costs one
+    broadcast multiply, not a materialized f32 copy.
     """
     b, d = x.shape
     s = k_l.shape[1]
     hd = d // num_heads
 
     h = _layer_norm(x, p["ln1"])
-    qkv = h @ p["qkv"]  # [b, 3d]
+    qkv = _mm(h, p["qkv"])  # [b, 3d]
     q, k_t, v_t = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, num_heads, hd)
+    k_t = k_t.reshape(b, num_heads, hd)
+    v_t = v_t.reshape(b, num_heads, hd)
     rows = jnp.arange(b)
-    k_l = k_l.at[rows, pos].set(
-        k_t.reshape(b, num_heads, hd).astype(k_l.dtype)
-    )
-    v_l = v_l.at[rows, pos].set(
-        v_t.reshape(b, num_heads, hd).astype(v_l.dtype)
-    )
-    scores = jnp.einsum("bhd,bshd->bhs", q, k_l) / jnp.sqrt(
+    if k_s is not None:
+        kq, ks_t = _q_kv(k_t)
+        vq, vs_t = _q_kv(v_t)
+        k_l = k_l.at[rows, pos].set(kq)
+        v_l = v_l.at[rows, pos].set(vq)
+        k_s = k_s.at[rows, pos].set(ks_t)
+        v_s = v_s.at[rows, pos].set(vs_t)
+        # attend with the EXACT current token (storage is quantized, the
+        # in-flight value costs nothing to keep f32) — only the stored
+        # history pays the 8-bit grid.  A select, not a scatter: XLA
+        # fuses the select+dequant into the consuming einsum, where a
+        # scatter would materialize the full f32 [B,S,h,hd] view.
+        own = (jnp.arange(s)[None, :] == pos[:, None])[..., None, None]
+        k_seq = jnp.where(own, k_t[:, None], _dq_kv(k_l, k_s))
+        v_seq = jnp.where(own, v_t[:, None], _dq_kv(v_l, v_s))
+    else:
+        k_l = k_l.at[rows, pos].set(k_t.astype(k_l.dtype))
+        v_l = v_l.at[rows, pos].set(v_t.astype(v_l.dtype))
+        k_seq, v_seq = k_l, v_l
+    scores = jnp.einsum("bhd,bshd->bhs", q, k_seq) / jnp.sqrt(
         jnp.asarray(hd, jnp.float32)
     )  # f32 via the f32 scale, matching block_apply
     visible = jnp.arange(s)[None, :] <= pos[:, None]  # [b, s]
     scores = jnp.where(visible[:, None, :], scores, -1e30)
-    attn = jax.nn.softmax(scores, axis=-1).astype(v_l.dtype)
-    ctx = jnp.einsum("bhs,bshd->bhd", attn, v_l).reshape(b, d).astype(x.dtype)
-    x = x + ctx @ p["proj"]
+    attn = jax.nn.softmax(scores, axis=-1).astype(v_seq.dtype)
+    ctx = jnp.einsum("bhs,bshd->bhd", attn, v_seq).reshape(b, d).astype(x.dtype)
+    x = x + _mm(ctx, p["proj"])
 
     h = _layer_norm(x, p["ln2"])
-    x = x + jax.nn.gelu(h @ p["w_in"], approximate=False) @ p["w_out"]
-    return x, k_l, v_l
+    x = x + _mm(jax.nn.gelu(_mm(h, p["w_in"]), approximate=False), p["w_out"])
+    return x, k_l, v_l, k_s, v_s
 
 
 def forward_decode(params, token, cache, pos, *, num_heads: int):
@@ -302,7 +330,9 @@ def forward_decode(params, token, cache, pos, *, num_heads: int):
     ``token``: [B] int32 — each slot's current token; ``pos``: [B] int32 —
     the position that token occupies (per-slot: continuous batching runs
     slots at different depths); ``cache``: ``{"k", "v"}`` each
-    ``[B, L, S, h, hd]`` (:mod:`serve.kv_cache` layout).
+    ``[B, L, S, h, hd]`` (:mod:`serve.kv_cache` layout), plus
+    ``{"k_scale", "v_scale"}`` ([B, L, S, h] f32) under the int8 layout —
+    writes quantize, reads dequantize fused into attention.
 
     Returns ``(logits [B, vocab], new_cache)`` where ``new_cache`` has the
     token's K/V written at ``pos`` in every layer.  O(S·d) per token per
@@ -314,32 +344,36 @@ def forward_decode(params, token, cache, pos, *, num_heads: int):
     buffers update in place instead of doubling HBM per step.
     """
     x = params["embed"][token] + params["pos"][pos]  # [B, d]
+    quantized = quantized_cache(cache)
 
     def body(carry, xs):
-        p, k_l, v_l = xs
-        carry, k_l, v_l = _block_decode(
-            p, carry, k_l, v_l, pos, num_heads=num_heads
+        p, k_l, v_l, k_s, v_s = xs
+        carry, k_l, v_l, k_s, v_s = _block_decode(
+            p, carry, k_l, v_l, pos, num_heads=num_heads, k_s=k_s, v_s=v_s
         )
-        return carry, (k_l, v_l)
+        return carry, (k_l, v_l, k_s, v_s)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body,
-        x,
-        (
-            params["blocks"],
-            jnp.moveaxis(cache["k"], 1, 0),
-            jnp.moveaxis(cache["v"], 1, 0),
-        ),
+    xs = (
+        params["blocks"],
+        jnp.moveaxis(cache["k"], 1, 0),
+        jnp.moveaxis(cache["v"], 1, 0),
+        jnp.moveaxis(cache["k_scale"], 1, 0) if quantized else None,
+        jnp.moveaxis(cache["v_scale"], 1, 0) if quantized else None,
     )
+    x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(body, x, xs)
     new_cache = {
         "k": jnp.moveaxis(k_new, 0, 1),
         "v": jnp.moveaxis(v_new, 0, 1),
     }
-    return x @ params["head"], new_cache
+    if quantized:
+        new_cache["k_scale"] = jnp.moveaxis(ks_new, 0, 1)
+        new_cache["v_scale"] = jnp.moveaxis(vs_new, 0, 1)
+    return _mm(x, params["head"]), new_cache
 
 
 def _block_decode_paged(
-    p, x, k_l, v_l, pos, block_tables, *, num_heads: int, page_size: int
+    p, x, k_l, v_l, pos, block_tables, *, num_heads: int, page_size: int,
+    k_s=None, v_s=None,
 ):
     """One block's single-token decode against a PAGED cache layer.
 
@@ -352,6 +386,10 @@ def _block_decode_paged(
     gathered pages with positions ``<= pos`` visible.  Released slots
     point every table entry at the scratch page and sit at pos 0, so their
     writes land in the dustbin and never touch a live page.
+
+    ``k_s``/``v_s`` ([pages, page_size, h] f32, int8 pool only): writes
+    quantize per head, the block-table gather pulls values AND scales,
+    and the dequant multiply fuses into the attention einsums.
     """
     b, d = x.shape
     nb = block_tables.shape[1]
@@ -359,21 +397,44 @@ def _block_decode_paged(
     hd = d // num_heads
 
     h = _layer_norm(x, p["ln1"])
-    qkv = h @ p["qkv"]  # [b, 3d]
+    qkv = _mm(h, p["qkv"])  # [b, 3d]
     q, k_t, v_t = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, num_heads, hd)
+    k_t = k_t.reshape(b, num_heads, hd)
+    v_t = v_t.reshape(b, num_heads, hd)
     rows = jnp.arange(b)
     page = block_tables[rows, pos // page_size]  # [b] physical page
     off = pos % page_size
-    k_l = k_l.at[page, off].set(
-        k_t.reshape(b, num_heads, hd).astype(k_l.dtype)
-    )
-    v_l = v_l.at[page, off].set(
-        v_t.reshape(b, num_heads, hd).astype(v_l.dtype)
-    )
-    # block-table gather: [b, nb, ps, h, hd] -> the slot's logical [s] view
-    k_seq = k_l[block_tables].reshape(b, s, num_heads, hd)
-    v_seq = v_l[block_tables].reshape(b, s, num_heads, hd)
+    if k_s is not None:
+        kq, ks_t = _q_kv(k_t)
+        vq, vs_t = _q_kv(v_t)
+        k_l = k_l.at[page, off].set(kq)
+        v_l = v_l.at[page, off].set(vq)
+        k_s = k_s.at[page, off].set(ks_t)
+        v_s = v_s.at[page, off].set(vs_t)
+        # exact current token in the attended view, via a fusable select
+        # (see _block_decode); pos < nb * page_size always
+        own = (jnp.arange(s)[None, :] == pos[:, None])[..., None, None]
+        k_seq = jnp.where(
+            own,
+            k_t[:, None],
+            _dq_kv(k_l[block_tables], k_s[block_tables]).reshape(
+                b, s, num_heads, hd
+            ),
+        )
+        v_seq = jnp.where(
+            own,
+            v_t[:, None],
+            _dq_kv(v_l[block_tables], v_s[block_tables]).reshape(
+                b, s, num_heads, hd
+            ),
+        )
+    else:
+        k_l = k_l.at[page, off].set(k_t.astype(k_l.dtype))
+        v_l = v_l.at[page, off].set(v_t.astype(v_l.dtype))
+        # block-table gather: [b, nb, ps, h, hd] -> the logical [s] view
+        k_seq = k_l[block_tables].reshape(b, s, num_heads, hd)
+        v_seq = v_l[block_tables].reshape(b, s, num_heads, hd)
     scores = jnp.einsum("bhd,bshd->bhs", q, k_seq) / jnp.sqrt(
         jnp.asarray(hd, jnp.float32)
     )
@@ -383,11 +444,11 @@ def _block_decode_paged(
     ctx = jnp.einsum("bhs,bshd->bhd", attn, v_seq).reshape(b, d).astype(
         x.dtype
     )
-    x = x + ctx @ p["proj"]
+    x = x + _mm(ctx, p["proj"])
 
     h = _layer_norm(x, p["ln2"])
-    x = x + jax.nn.gelu(h @ p["w_in"], approximate=False) @ p["w_out"]
-    return x, k_l, v_l
+    x = x + _mm(jax.nn.gelu(_mm(h, p["w_in"]), approximate=False), p["w_out"])
+    return x, k_l, v_l, k_s, v_s
 
 
 def forward_decode_paged(
@@ -404,31 +465,39 @@ def forward_decode_paged(
     gate in ``tests/test_paged_cache.py`` pins it): the gathered page view
     reconstructs exactly the dense ``[B, S, h, hd]`` key/value sequence,
     padded with masked positions up to ``nb * page_size``.
+
+    Int8 pool (``{"k_scale", "v_scale"}`` present, [pages, L, page_size,
+    h] f32): same program with quantize-on-write and a gather+dequant
+    fused into attention — the math matches the f32 paged path up to the
+    8-bit grid (``bench.py --quant`` reports the agreement rate and MAE).
     """
     x = params["embed"][token] + params["pos"][pos]  # [B, d]
+    quantized = quantized_cache(cache)
 
     def body(carry, xs):
-        p, k_l, v_l = xs
-        carry, k_l, v_l = _block_decode_paged(
+        p, k_l, v_l, k_s, v_s = xs
+        carry, k_l, v_l, k_s, v_s = _block_decode_paged(
             p, carry, k_l, v_l, pos, block_tables,
-            num_heads=num_heads, page_size=page_size,
+            num_heads=num_heads, page_size=page_size, k_s=k_s, v_s=v_s,
         )
-        return carry, (k_l, v_l)
+        return carry, (k_l, v_l, k_s, v_s)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body,
-        x,
-        (
-            params["blocks"],
-            jnp.moveaxis(cache["k"], 1, 0),
-            jnp.moveaxis(cache["v"], 1, 0),
-        ),
+    xs = (
+        params["blocks"],
+        jnp.moveaxis(cache["k"], 1, 0),
+        jnp.moveaxis(cache["v"], 1, 0),
+        jnp.moveaxis(cache["k_scale"], 1, 0) if quantized else None,
+        jnp.moveaxis(cache["v_scale"], 1, 0) if quantized else None,
     )
+    x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(body, x, xs)
     new_cache = {
         "k": jnp.moveaxis(k_new, 0, 1),
         "v": jnp.moveaxis(v_new, 0, 1),
     }
-    return x @ params["head"], new_cache
+    if quantized:
+        new_cache["k_scale"] = jnp.moveaxis(ks_new, 0, 1)
+        new_cache["v_scale"] = jnp.moveaxis(vs_new, 0, 1)
+    return _mm(x, params["head"]), new_cache
 
 
 def forward_prefill_chunk(
@@ -450,6 +519,11 @@ def forward_prefill_chunk(
     Returns ``(logits [1, C, vocab], new_cache)``.  Positions that
     overflow the block table (final-chunk padding) are routed to the
     scratch page; their outputs are garbage and the caller ignores them.
+
+    Int8 pool: the chunk's K/V quantize on write (per-position-per-head
+    scales) and the page gather dequantizes into attention — so chunk
+    token ``i`` attends to the same cache-roundtripped history a later
+    decode step will read, keeping prefill and decode numerics coherent.
     """
     b, C = tokens.shape
     if b != 1:
@@ -471,21 +545,41 @@ def forward_prefill_chunk(
     )  # [C, d]
     d = x.shape[-1]
     hd = d // num_heads
+    quantized = quantized_cache(cache)
 
     def body(carry, xs):
-        p, k_l, v_l = xs
+        p, k_l, v_l, k_s, v_s = xs
         h = _layer_norm(carry, p["ln1"])
-        qkv = h @ p["qkv"]  # [C, 3d]
+        qkv = _mm(h, p["qkv"])  # [C, 3d]
         q, k_c, v_c = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(C, num_heads, hd)
-        k_l = k_l.at[pages, offs].set(
-            k_c.reshape(C, num_heads, hd).astype(k_l.dtype)
-        )
-        v_l = v_l.at[pages, offs].set(
-            v_c.reshape(C, num_heads, hd).astype(v_l.dtype)
-        )
-        k_seq = k_l[block_table].reshape(s, num_heads, hd)
-        v_seq = v_l[block_table].reshape(s, num_heads, hd)
+        k_c = k_c.reshape(C, num_heads, hd)
+        v_c = v_c.reshape(C, num_heads, hd)
+        if k_s is not None:
+            kq, ks_c = _q_kv(k_c)
+            vq, vs_c = _q_kv(v_c)
+            k_l = k_l.at[pages, offs].set(kq)
+            v_l = v_l.at[pages, offs].set(vq)
+            k_s = k_s.at[pages, offs].set(ks_c)
+            v_s = v_s.at[pages, offs].set(vs_c)
+            # Prefill attends over the cache-roundtripped values for the
+            # own chunk TOO (no exact-self overlay here, unlike decode):
+            # per-token quantization is chunk-ALIGNMENT-invariant, so a
+            # prefix-cache hit (which shifts the chunk offset by the
+            # shared length) produces bit-identical logits to a cold
+            # run — an exact-own-chunk window would make the numbers
+            # depend on where the chunk boundaries fell.
+            k_seq = _dq_kv(k_l[block_table], k_s[block_table]).reshape(
+                s, num_heads, hd
+            )
+            v_seq = _dq_kv(v_l[block_table], v_s[block_table]).reshape(
+                s, num_heads, hd
+            )
+        else:
+            k_l = k_l.at[pages, offs].set(k_c.astype(k_l.dtype))
+            v_l = v_l.at[pages, offs].set(v_c.astype(v_l.dtype))
+            k_seq = k_l[block_table].reshape(s, num_heads, hd)
+            v_seq = v_l[block_table].reshape(s, num_heads, hd)
         scores = jnp.einsum("chd,shd->chs", q, k_seq) / jnp.sqrt(
             jnp.asarray(hd, jnp.float32)
         )
@@ -495,25 +589,29 @@ def forward_prefill_chunk(
         ctx = jnp.einsum("chs,shd->chd", attn, v_seq).reshape(C, d).astype(
             carry.dtype
         )
-        out = carry + ctx @ p["proj"]
+        out = carry + _mm(ctx, p["proj"])
         h = _layer_norm(out, p["ln2"])
-        out = out + jax.nn.gelu(h @ p["w_in"], approximate=False) @ p["w_out"]
-        return out, (k_l, v_l)
+        out = out + _mm(
+            jax.nn.gelu(_mm(h, p["w_in"]), approximate=False), p["w_out"]
+        )
+        return out, (k_l, v_l, k_s, v_s)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body,
-        x,
-        (
-            params["blocks"],
-            jnp.moveaxis(cache["k"], 1, 0),
-            jnp.moveaxis(cache["v"], 1, 0),
-        ),
+    xs = (
+        params["blocks"],
+        jnp.moveaxis(cache["k"], 1, 0),
+        jnp.moveaxis(cache["v"], 1, 0),
+        jnp.moveaxis(cache["k_scale"], 1, 0) if quantized else None,
+        jnp.moveaxis(cache["v_scale"], 1, 0) if quantized else None,
     )
+    x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(body, x, xs)
     new_cache = {
         "k": jnp.moveaxis(k_new, 0, 1),
         "v": jnp.moveaxis(v_new, 0, 1),
     }
-    return (x @ params["head"])[None], new_cache
+    if quantized:
+        new_cache["k_scale"] = jnp.moveaxis(ks_new, 0, 1)
+        new_cache["v_scale"] = jnp.moveaxis(vs_new, 0, 1)
+    return _mm(x, params["head"])[None], new_cache
 
 
 # Which width dim of each stacked block leaf ZeRO-3 shards (leaf layout

@@ -42,6 +42,7 @@ from distributeddeeplearning_tpu.models.pipelined_transformer import (
     forward_prefill,
     forward_prefill_chunk,
 )
+from distributeddeeplearning_tpu.quant.calibrate import params_dtype
 from distributeddeeplearning_tpu.serve.kv_cache import (
     OutOfPages,
     PageAllocator,
@@ -205,6 +206,10 @@ class InferenceEngine:
         self.vocab_size = params["head"].shape[1]
         if cache_dtype is None:
             cache_dtype = params["embed"].dtype
+        # provenance the ServeReport carries: an int8 artifact must be
+        # distinguishable from an f32 one without diffing configs
+        self.kv_dtype = np.dtype(cache_dtype).name
+        self.weights_dtype = params_dtype(params)
         self._base_rng = jax.random.key(0) if rng is None else rng
         self._sample_step = 0
 
@@ -230,7 +235,7 @@ class InferenceEngine:
 
             from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
 
-            c_shard = cache_sharding(mesh)
+            c_shard = cache_sharding(mesh, quantized=self.kv_dtype == "int8")
             rep = NamedSharding(mesh, P())
             slot_vec = NamedSharding(mesh, P(DATA_AXES))
             p_shard = jax.tree_util.tree_map(lambda _: rep, params)
@@ -428,6 +433,7 @@ class PagedInferenceEngine:
         rng: Optional[jax.Array] = None,
         pad_id: int = 0,
         prefix_cache: bool = True,
+        capture_logits: bool = False,
     ):
         _, num_layers, head_dim = _validate_model_dims(
             params, num_heads=num_heads, max_seq=max_seq, top_k=top_k
@@ -451,6 +457,15 @@ class PagedInferenceEngine:
         self.vocab_size = params["head"].shape[1]
         if cache_dtype is None:
             cache_dtype = params["embed"].dtype
+        self.kv_dtype = np.dtype(cache_dtype).name
+        self.weights_dtype = params_dtype(params)
+        # fidelity-probe hook (bench.py --quant): keep the last decode
+        # step's / final prefill chunk's logits host-side for comparison
+        # against a reference engine — off in production (one extra
+        # device->host copy per step)
+        self.capture_logits = capture_logits
+        self.last_logits: Optional[np.ndarray] = None
+        self.last_prefill_logits: Optional[np.ndarray] = None
         self._base_rng = jax.random.key(0) if rng is None else rng
         self._sample_step = 0
 
@@ -505,17 +520,26 @@ class PagedInferenceEngine:
                 num_heads=num_heads, page_size=page_size,
             )
 
-        def _decode_fn(params, cache, tokens, pos, block_tables, step):
+        def _decode_fn(params, cache, tokens, pos, block_tables, step,
+                       with_logits):
             logits, cache = forward_decode_paged(
                 params, tokens, cache, pos, block_tables,
                 num_heads=num_heads, page_size=page_size,
             )
+            # ``with_logits`` is static: the production program (False)
+            # never materializes a [B, vocab] output it would discard —
+            # logits stay a fusable intermediate of the sampler; the
+            # probe variant (True) compiles separately on first use
+            if with_logits:
+                return _sample(logits, step), logits, cache
             return _sample(logits, step), cache
 
         # one compiled chunk program per chunk shape (<= log2(chunk) of
         # them: full chunks plus power-of-two final-chunk buckets)
         self._chunk_jit = jax.jit(_chunk_fn, donate_argnums=(1,))
-        self._decode_jit = jax.jit(_decode_fn, donate_argnums=(1,))
+        self._decode_jit = jax.jit(
+            _decode_fn, donate_argnums=(1,), static_argnums=(6,)
+        )
         self._sample_jit = jax.jit(_sample)
         logger.info(
             "paged engine: %d slots, %d pages x %d tokens (+scratch), %d "
@@ -718,6 +742,8 @@ class PagedInferenceEngine:
         last = jax.lax.dynamic_index_in_dim(
             logits, real - 1, axis=1, keepdims=False
         )  # [1, vocab] — last REAL position of the final chunk
+        if self.capture_logits:
+            self.last_prefill_logits = np.asarray(last)[0]
         tok = self._sample_jit(last, jnp.int32(self._next_step()))
         return int(np.asarray(tok)[0])
 
@@ -745,7 +771,7 @@ class PagedInferenceEngine:
         """One decode step for every slot via block-table gather.  Same
         contract as the dense engine; released slots' rows point at the
         scratch page so their (ignored) lane writes are harmless."""
-        toks, self._cache = self._decode_jit(
+        args = (
             self.params,
             self._cache,
             jnp.asarray(tokens, jnp.int32),
@@ -753,6 +779,11 @@ class PagedInferenceEngine:
             jnp.asarray(self._block_tables),
             jnp.int32(self._next_step()),
         )
+        if self.capture_logits:
+            toks, logits, self._cache = self._decode_jit(*args, True)
+            self.last_logits = np.asarray(logits)
+        else:
+            toks, self._cache = self._decode_jit(*args, False)
         return np.asarray(toks)
 
     def release(self, slot: int) -> None:

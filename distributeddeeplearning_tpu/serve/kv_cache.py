@@ -26,11 +26,20 @@ from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
+from distributeddeeplearning_tpu.quant.qtensor import (
+    quantize_kv,
+    quantized_cache,
+)
 
 Cache = Dict[str, jax.Array]
+
+
+def _is_int8(dtype) -> bool:
+    return np.dtype(dtype) == np.int8
 
 #: Page id 0 is a reserved scratch page: released/inactive decode slots and
 #: out-of-range block-table entries point at it, so their (masked, ignored)
@@ -51,18 +60,33 @@ def init_cache(
 
     Zeros are never *read*: the decode position mask hides every position
     above a slot's current length, and admission overwrites from 0.
+
+    ``dtype=jnp.int8`` selects the quantized layout: values int8 plus f32
+    per-position-per-head scale leaves ``{"k_scale", "v_scale"}``, each
+    [slots, L, S, h] — ~(1 + 4/hd)/4 of the f32 footprint.
     """
     shape = (batch_slots, num_layers, max_seq, num_heads, head_dim)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    cache = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if _is_int8(dtype):
+        cache["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        cache["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+    return cache
 
 
-def cache_sharding(mesh) -> Cache:
+def cache_sharding(mesh, *, quantized: bool = False) -> Cache:
     """NamedShardings for the cache: slots over the data axes, heads over
     ``tensor`` — the serving analogue of the training batch/TP layout, so
-    an engine built on the training mesh reuses its geometry unchanged."""
+    an engine built on the training mesh reuses its geometry unchanged.
+    The int8 layout's scale leaves shard identically (they carry the same
+    slot/head dims, just no head_dim)."""
     spec = P(DATA_AXES, None, None, "tensor", None)
     s = NamedSharding(mesh, spec)
-    return {"k": s, "v": s}
+    out = {"k": s, "v": s}
+    if quantized:
+        sc = NamedSharding(mesh, P(DATA_AXES, None, None, "tensor"))
+        out["k_scale"] = sc
+        out["v_scale"] = sc
+    return out
 
 
 def insert_sequence(cache: Cache, k: jax.Array, v: jax.Array, slot) -> Cache:
@@ -73,10 +97,27 @@ def insert_sequence(cache: Cache, k: jax.Array, v: jax.Array, slot) -> Cache:
     land above the slot's length and stay masked until overwritten by
     decode steps.  ``slot`` may be a traced index (one compiled insert
     serves every slot).
+
+    Int8 caches quantize here (per-position-per-head scales written
+    alongside the values) — the prefill pass itself stays f32; only the
+    stored history is 8-bit.
     """
     if k.ndim == 4:
         k, v = k[None], v[None]
     start = (slot, 0, 0, 0, 0)
+    if quantized_cache(cache):
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        return {
+            "k": jax.lax.dynamic_update_slice(cache["k"], kq, start),
+            "v": jax.lax.dynamic_update_slice(cache["v"], vq, start),
+            "k_scale": jax.lax.dynamic_update_slice(
+                cache["k_scale"], ks, start[:-1]
+            ),
+            "v_scale": jax.lax.dynamic_update_slice(
+                cache["v_scale"], vs, start[:-1]
+            ),
+        }
     return {
         "k": jax.lax.dynamic_update_slice(
             cache["k"], k.astype(cache["k"].dtype), start
@@ -88,8 +129,13 @@ def insert_sequence(cache: Cache, k: jax.Array, v: jax.Array, slot) -> Cache:
 
 
 def cache_bytes(cache: Cache) -> int:
-    """Total cache footprint in bytes (the serving HBM budget line)."""
-    return sum(leaf.size * leaf.dtype.itemsize for leaf in cache.values())
+    """Total cache footprint in bytes (the serving HBM budget line) —
+    summed over EVERY leaf of the pytree (k, v, and the int8 layout's
+    scale tensors), so the accounting stays honest whatever the layout."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(cache)
+    )
 
 
 # --------------------------------------------------------------------------
@@ -123,21 +169,31 @@ def init_paged_cache(
     write target.  Page-major so one page is a contiguous leading-dim slice
     and the block-table gather in ``forward_decode_paged`` is a single
     leading-axis take.
+
+    ``dtype=jnp.int8`` adds f32 scale pools ``{"k_scale", "v_scale"}``,
+    each [pages, L, page_size, h] — one scale per stored K/V vector, so
+    incremental token writes never force a page-wide requantize.
     """
     if num_pages < 1:
         raise ValueError(f"num_pages must be >= 1, got {num_pages}")
     if page_size < 1:
         raise ValueError(f"page_size must be >= 1, got {page_size}")
     shape = (num_pages + 1, num_layers, page_size, num_heads, head_dim)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    cache = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if _is_int8(dtype):
+        cache["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        cache["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+    return cache
 
 
 def page_bytes(cache: Cache) -> int:
     """Bytes of ONE page across k+v and all layers — the HBM granule the
-    allocator hands out (``cache_bytes == (num_pages+1) * page_bytes``)."""
+    allocator hands out (``cache_bytes == (num_pages+1) * page_bytes``).
+    Sums EVERY pool leaf, so the int8 layout's per-page scale bytes are
+    charged to the page they belong to."""
     return sum(
         leaf.size // leaf.shape[0] * leaf.dtype.itemsize
-        for leaf in cache.values()
+        for leaf in jax.tree_util.tree_leaves(cache)
     )
 
 
@@ -310,13 +366,23 @@ def insert_pages(
     ``page_size``) into the pool pages listed in ``page_ids`` — the paged
     analogue of :func:`insert_sequence`, used by tests and one-shot
     (non-chunked) inserts; the engine's chunked prefill writes pages inside
-    the compiled chunk program instead."""
+    the compiled chunk program instead.  Int8 pools quantize on the way in
+    (per-position-per-head scales scattered alongside the values)."""
     if k.ndim == 5:
         k, v = k[0], v[0]
     L, P, h, hd = k.shape
     n = P // page_size
     paged_k = k.reshape(L, n, page_size, h, hd).swapaxes(0, 1)
     paged_v = v.reshape(L, n, page_size, h, hd).swapaxes(0, 1)
+    if quantized_cache(cache):
+        kq, ks = quantize_kv(paged_k)
+        vq, vs = quantize_kv(paged_v)
+        return {
+            "k": cache["k"].at[page_ids].set(kq),
+            "v": cache["v"].at[page_ids].set(vq),
+            "k_scale": cache["k_scale"].at[page_ids].set(ks),
+            "v_scale": cache["v_scale"].at[page_ids].set(vs),
+        }
     return {
         "k": cache["k"].at[page_ids].set(paged_k.astype(cache["k"].dtype)),
         "v": cache["v"].at[page_ids].set(paged_v.astype(cache["v"].dtype)),

@@ -103,6 +103,10 @@ class ServeReport:
     # prefill shapes compiled during THIS run (mid-run jit stalls)
     prefill_compiles: int = 0
     kv_layout: str = "dense"
+    # storage dtypes (quant provenance): an int8-KV or int8-weight
+    # artifact is distinguishable from an f32 one without diffing configs
+    kv_dtype: str = "float32"
+    weights_dtype: str = "float32"
     prefix_hit_rate: float = 0.0  # prompt tokens served from shared pages
     kv_bytes: int = 0  # KV pool bytes reserved
     # peak bytes committed to live sequences — equals kv_bytes under the
@@ -472,6 +476,8 @@ class ContinuousBatchingScheduler:
                 getattr(engine, "prefill_compiles", 0) - compiles_before
             ),
             kv_layout=getattr(engine, "kv_layout", "dense"),
+            kv_dtype=getattr(engine, "kv_dtype", "float32"),
+            weights_dtype=getattr(engine, "weights_dtype", "float32"),
             prefix_hit_rate=(
                 round(engine.prefix_hit_rate(), 4)
                 if hasattr(engine, "prefix_hit_rate")

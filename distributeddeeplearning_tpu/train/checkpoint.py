@@ -124,7 +124,7 @@ class Checkpointer:
         logger.info("restored checkpoint step %d from %s", step, self.directory)
         return state, step
 
-    def restore_params(self):
+    def restore_params(self, *, quantize_weights: Optional[str] = None):
         """Restore only the latest checkpoint's ``params`` subtree.
 
         The serving path (``ddlt serve``) needs the weights but neither the
@@ -132,6 +132,15 @@ class Checkpointer:
         reconstruct the training-time optimizer just to satisfy
         :meth:`restore`'s template.  Arrays come back host-resident (no
         target shardings); the engine places them onto its own mesh.
+
+        ``quantize_weights="int8"`` materializes the quantized serving
+        pytree directly from the f32 checkpoint: the matmul weights come
+        back as int8 ``QTensor`` leaves (per-output-channel absmax scales,
+        ``quant.calibrate.quantize_params``) without the caller ever
+        holding a second full-precision copy past restore.  Use
+        ``quant.calibrate.calibrate_params`` instead when a fidelity
+        report over calibration prompts is wanted (``ddlt serve
+        --quantize-weights int8 --calib-prompts N`` does).
 
         Cost note: the whole saved tree is read and the non-params subtrees
         dropped — for an AdamW checkpoint ~3x the bytes actually needed.
@@ -141,6 +150,14 @@ class Checkpointer:
 
         Returns ``(params, step)``; ``(None, None)`` when no checkpoint.
         """
+        if quantize_weights not in (None, "int8"):
+            # validate BEFORE the restore: reading the whole saved tree
+            # (~3x the params bytes) just to raise on a typo'd mode
+            # would waste the startup cost this method exists to bound
+            raise ValueError(
+                f"unsupported quantize_weights {quantize_weights!r} "
+                "(only 'int8')"
+            )
         step = self.latest_step()
         if step is None:
             return None, None
@@ -154,7 +171,15 @@ class Checkpointer:
             "restored params of checkpoint step %d from %s",
             step, self.directory,
         )
-        return restored["params"], step
+        params = restored["params"]
+        if quantize_weights is not None:
+            from distributeddeeplearning_tpu.quant.calibrate import (
+                quantize_params,
+            )
+
+            params = quantize_params(params)
+            logger.info("quantized restored params to int8 (absmax PTQ)")
+        return params, step
 
     def wait(self) -> None:
         """Drain pending async saves, retrying transient storage failures

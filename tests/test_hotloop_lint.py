@@ -11,6 +11,12 @@ source for per-step host syncs (``float(``, ``.item()``, ``np.asarray``,
 one-sync-per-step price).  The jitted step builders are held to a stricter
 bar: no such token at all (inside jit they would either crash or silently
 fall back to host math).
+
+The serve scheduler's decode loop gets the same treatment: its one
+designed sync is the sampled-token readback inside ``engine.decode``
+(host-side continuous batching needs the ids), so any OTHER per-step sync
+token in ``ContinuousBatchingScheduler.run``'s loop body fails the lint
+unless allow-listed.
 """
 
 import inspect
@@ -61,6 +67,55 @@ def test_trainer_step_loop_allowlist_is_alive():
     body = _step_loop_body()
     marked = [line for line in body if MARKER in line and BANNED.search(line)]
     assert marked, "no allow-listed sync lines found — lint may be scanning the wrong region"
+
+
+def _serve_loop_body():
+    """Source lines of the scheduler's ``while pending or active ...``
+    decode loop inside ``ContinuousBatchingScheduler.run`` (by
+    indentation, comments included) — the serving hot loop: one decode
+    step per iteration, admission between steps."""
+    from distributeddeeplearning_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    lines = inspect.getsource(ContinuousBatchingScheduler.run).splitlines()
+    start = next(
+        i for i, line in enumerate(lines)
+        if "while pending or active" in line
+    )
+    indent = len(lines[start]) - len(lines[start].lstrip())
+    body = []
+    for line in lines[start + 1:]:
+        if line.strip() and (len(line) - len(line.lstrip())) <= indent:
+            break
+        body.append(line)
+    assert body, "could not locate the serve decode loop body"
+    return body
+
+
+def test_serve_decode_loop_has_no_unmarked_host_sync():
+    """Same lint as the trainer loop, for the serving hot path: the
+    scheduler's ONE designed host sync is the token readback inside
+    ``engine.decode`` (the host-side scheduler needs the sampled ids to
+    admit/release slots) — anything else (``float(``/``.item()``/
+    ``np.asarray``/``device_get``) in the loop body is a new per-step
+    stall and must carry a ``# sync-ok`` marker with its justification."""
+    body = _serve_loop_body()
+    # right-region guard: the loop we grep must be the one that decodes
+    assert any("engine.decode" in line for line in body), (
+        "serve lint is not scanning the decode loop"
+    )
+    offenders = [
+        line.strip()
+        for line in body
+        if BANNED.search(line) and MARKER not in line
+    ]
+    assert not offenders, (
+        "per-step host sync in the serve scheduler's decode loop — this "
+        "serializes dispatch against every decode step.  Move it to the "
+        "end-of-run report block, or tag a deliberate documented price "
+        f"with '# {MARKER}':\n  " + "\n  ".join(offenders)
+    )
 
 
 def test_step_builders_have_no_host_sync_tokens():
