@@ -42,7 +42,7 @@ V100_TF_CNN_BENCHMARKS_IMG_SEC = 720.0
 #: ``_rNN`` suffix (the drift that left COMMS at r09 while RESILIENCE sat
 #: at r07).  Committed artifacts keep their historical names; NEW runs
 #: write ``<KIND>_r{BENCH_REVISION}.json``.
-BENCH_REVISION = 10
+BENCH_REVISION = 11
 
 
 def artifact_name(kind: str) -> str:
@@ -1234,6 +1234,208 @@ def _run_quant(args) -> int:
     return 0
 
 
+def _run_obs(args) -> int:
+    """Observability benchmark: one merged host+device timeline over the
+    f32 and int8-KV serving engines, plus the decode-phase attribution
+    QUANT_r10 was missing.
+
+    Runs identical greedy traffic through an f32 paged engine and an
+    int8-KV paged engine with the obs tracer enabled inside a
+    ``jax.profiler.trace`` window, then:
+
+    - merges the host spans (request lifecycles, prefill chunks, decode
+      steps, dispatch-vs-readback) with the device profile onto one
+      Chrome-trace timeline (full trace written next to the artifact,
+      a digest embedded in it);
+    - measures each engine's decode step as per-phase jitted programs
+      (page gather / scale dequant / attention+MLP residual) and names
+      the phase that explains the int8 regression — the hottest phase
+      and its share of the int8 step time;
+    - attaches the roofline per-op analysis when the platform's trace
+      carries XLA cost-model annotations (TPU; reported absent on CPU);
+    - snapshots the metrics registry (TTFT/TPOT/decode-step histograms
+      both runs fed) into the artifact.
+
+    Emits ``OBS_r{NN}.json`` — validated against ``obs.schema`` before it
+    is written, so the artifact can never drift from what tier-1 checks.
+    """
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        init_params,
+    )
+    from distributeddeeplearning_tpu.obs import (
+        MetricsRegistry,
+        configure,
+        get_registry,
+        set_registry,
+    )
+    from distributeddeeplearning_tpu.obs.profile import (
+        attribute_regression,
+        decode_phase_breakdown,
+        device_analysis,
+        profile_and_merge,
+        summarize_timeline,
+    )
+    from distributeddeeplearning_tpu.obs.schema import validate_obs_payload
+    from distributeddeeplearning_tpu.serve import (
+        ContinuousBatchingScheduler,
+        PagedInferenceEngine,
+        synthetic_requests,
+    )
+
+    dims = dict(num_layers=12, d_model=768, num_heads=12, d_ff=3072,
+                vocab_size=32768)
+    if args.small:
+        dims = dict(num_layers=2, d_model=64, num_heads=4, d_ff=128,
+                    vocab_size=257)
+    max_prompt = max(8, args.seq_len)
+    max_seq = max_prompt + args.max_new_tokens
+    params = init_params(jax.random.key(0), max_len=max_seq, **dims)
+
+    def build(cache_dtype=None):
+        return PagedInferenceEngine(
+            params,
+            num_heads=dims["num_heads"],
+            batch_slots=args.batch_slots,
+            max_seq=max_seq,
+            page_size=args.page_size,
+            num_pages=args.kv_pages,
+            prefill_chunk=args.prefill_chunk,
+            temperature=0.0,
+            rng=jax.random.key(1),
+            cache_dtype=cache_dtype,
+        )
+
+    engines = {"f32": build(), "kv_int8": build(jnp.int8)}
+    requests = synthetic_requests(
+        args.serve_requests, vocab_size=dims["vocab_size"],
+        max_prompt=max_prompt, min_prompt=max(2, max_prompt // 8),
+        rng=np.random.default_rng(0),
+    )
+    smoke = args.steps_cap is not None
+    trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="ddlt-obs-")
+    tracer = configure(enabled=False)  # enabled inside the trace window
+
+    def run_one(name, engine):
+        with tracer.span(f"obs/serve_{name}"):
+            _, report = ContinuousBatchingScheduler(
+                engine,
+                max_new_tokens=args.max_new_tokens,
+                step_cap=args.steps_cap,
+            ).run(list(requests))
+        if not smoke:
+            assert report.prefill_compiles == 0, (
+                f"warmup missed {report.prefill_compiles} prefill shape(s)"
+            )
+        return report
+
+    # warmup OUTSIDE the profiled window: the timeline should show
+    # serving, not compilation
+    if not smoke:
+        for engine in engines.values():
+            _serve_warmup(
+                engine, max_seq, requests, vocab_size=dims["vocab_size"]
+            )
+    # the warmup schedulers above rolled their compile-dominated samples
+    # into the process registry; the artifact's obs_metrics must reflect
+    # the PROFILED runs only, so start it fresh here
+    set_registry(MetricsRegistry())
+    reports = {}
+    breakdowns = {}
+    phase_iters = 2 if smoke else 10
+    def _windowed():
+        for name, engine in engines.items():
+            reports[name] = run_one(name, engine)
+        with tracer.span("obs/phase_breakdown"):
+            for name, engine in engines.items():
+                breakdowns[name] = decode_phase_breakdown(
+                    engine, iters=phase_iters,
+                    warmup=1 if smoke else 2,
+                )
+
+    _, _, merged, merged_path = profile_and_merge(
+        _windowed, trace_dir=trace_dir, tracer=tracer
+    )
+    attribution = attribute_regression(
+        breakdowns["f32"], breakdowns["kv_int8"]
+    )
+    # data-driven verdict sentence: artifacts get quoted without their
+    # context, so the number's meaning travels with it — including when
+    # the regression under test does NOT reproduce (which is exactly the
+    # attribution a host-noise-contaminated earlier artifact needs)
+    reg_ms = attribution["regression_ms"]
+    hp_ms = attribution["hottest_phase_delta_ms"]
+    attribution["note"] = (
+        f"int8-KV decode {'REGRESSED' if reg_ms > 0 else 'improved'} by "
+        f"{abs(reg_ms):.1f} ms vs f32 at full-history steady state on "
+        f"this host; the phase that "
+        f"{'grew most' if hp_ms > 0 else 'shrank least'} is "
+        f"{attribution['hottest_phase']} ({hp_ms:+.1f} ms, "
+        f"{attribution['hottest_phase_share_of_step_time']:.1%} of the "
+        f"int8 step)"
+        + (
+            "" if reg_ms > 0 else
+            " — a gap larger than this in another artifact's decode "
+            "step (e.g. QUANT's) was not the quantized math"
+        )
+    )
+
+    line = {
+        "metric": "lm_serve_obs_int8_decode_hottest_phase_share",
+        # the named hottest phase's share of the int8 decode step — the
+        # attribution number ROADMAP Open item 2 (fused int8 kernels)
+        # gates its fix against
+        "value": attribution["hottest_phase_share_of_step_time"],
+        "unit": "fraction_of_step",
+        "vs_baseline": None,
+        "bench_revision": BENCH_REVISION,
+        "max_seq": max_seq,
+        "page_size": args.page_size,
+        "prefill_chunk": args.prefill_chunk,
+        "regression_attribution": attribution,
+        "decode_breakdown": breakdowns,
+        "timeline": summarize_timeline(merged),
+        "merged_trace_path": merged_path,
+        # the profiler window spans BOTH engines' prefills + decodes plus
+        # the phase-timing loops, so there is no single-engine step count
+        # to normalize by: steps=1 makes every per-step roofline figure a
+        # per-WINDOW total, and the scope note travels with the numbers
+        "device_analysis": {
+            **device_analysis(trace_dir, steps=1),
+            "scope": (
+                "whole --obs profile window (f32 + int8 serve runs + "
+                "phase-timing loops); per-step keys are per-window "
+                "totals, not per-decode-step"
+            ),
+        },
+        "serve_reports": {
+            name: _serve_line(rep, engines[name], args,
+                              max_prompt=max_prompt)
+            for name, rep in reports.items()
+        },
+        "obs_metrics": get_registry().snapshot(),
+        "platform": jax.default_backend(),
+        "virtual_pod": _is_virtual_pod(),
+    }
+    # self-check before emitting: the artifact the README documents is
+    # the artifact tier-1 validates — drift fails HERE, not months later
+    validate_obs_payload(line)
+    print(json.dumps(line))
+    report_path = args.report or artifact_name("OBS")
+    with open(report_path, "w") as f:
+        json.dump(line, f, indent=2)
+        f.write("\n")
+    print(f"[obs] report -> {report_path}", file=sys.stderr)
+    print(f"[obs] merged chrome trace -> {merged_path}", file=sys.stderr)
+    return 0
+
+
 def _run_faults(args) -> int:
     """Chaos benchmark: the REAL ``ddlt train --max-restarts`` supervisor
     driven over an injected fault schedule, measured against the identical
@@ -1867,6 +2069,15 @@ def main() -> int:
         "teacher-forced logit MAE; emits the QUANT_r{NN}.json artifact",
     )
     parser.add_argument(
+        "--obs",
+        action="store_true",
+        help="observability benchmark: run the f32 and int8-KV paged "
+        "serving engines under the obs tracer + jax.profiler, emit the "
+        "OBS_r{NN}.json artifact (merged host+device timeline digest, "
+        "per-phase decode breakdown, int8-regression attribution); the "
+        "full merged Chrome trace lands in --trace-dir",
+    )
+    parser.add_argument(
         "--comms",
         action="store_true",
         help="benchmark the explicit gradient-comms schedule "
@@ -1918,8 +2129,8 @@ def main() -> int:
     parser.add_argument(
         "--report",
         default=None,
-        help="with --faults: also write the JSON line here "
-        "(default: RESILIENCE_r{NN}.json at the current BENCH_REVISION)",
+        help="artifact output path for --faults/--quant/--comms/--obs "
+        "(default: <KIND>_r{NN}.json at the current BENCH_REVISION)",
     )
     parser.add_argument(
         "--data",
@@ -1950,9 +2161,15 @@ def main() -> int:
     if args.fit and args.model == "lm":
         parser.error("--fit is not supported for --model lm")
     if args.quant and (args.serve or args.devices or args.data
-                       or args.faults or args.comms):
+                       or args.faults or args.comms or args.obs):
         parser.error(
             "--quant is exclusive with --serve/--devices/--data/"
+            "--faults/--comms/--obs"
+        )
+    if args.obs and (args.serve or args.devices or args.data
+                     or args.faults or args.comms):
+        parser.error(
+            "--obs is exclusive with --serve/--devices/--data/"
             "--faults/--comms"
         )
     if args.serve and args.devices:
@@ -2036,6 +2253,8 @@ def main() -> int:
         return _run_faults(args)
     if args.quant:
         return _run_quant(args)
+    if args.obs:
+        return _run_obs(args)
     if args.comms:
         return _run_comms(args)
     if args.devices:

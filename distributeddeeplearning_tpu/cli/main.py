@@ -409,6 +409,11 @@ def build_parser() -> argparse.ArgumentParser:
     serve_p.add_argument("--report", default=None,
                          help="also write the stats JSON here "
                          "(e.g. SERVE_r06.json)")
+    serve_p.add_argument("--trace-dir", default=None,
+                         help="enable the obs tracer + jax.profiler for "
+                         "this run and write the merged host+device "
+                         "Chrome trace (merged.trace.json — open in "
+                         "chrome://tracing or Perfetto) under this dir")
     for flag, default in (("--num-layers", 2), ("--d-model", 64),
                           ("--d-ff", 128), ("--vocab-size", 257)):
         serve_p.add_argument(flag, type=int, default=default,
@@ -421,6 +426,34 @@ def build_parser() -> argparse.ArgumentParser:
         "saved qkv shapes, and a wrong-but-dividing value generates "
         "garbage silently",
     )
+
+    obs_p = sub.add_parser(
+        "obs",
+        help="Profile a short train or serve run with the obs stack "
+        "(obs/): host spans + jax.profiler merged onto one Chrome-trace "
+        "timeline, metrics-registry snapshot, summary JSON to stdout",
+    )
+    obs_sub = obs_p.add_subparsers(dest="obs_command", required=True)
+    obs_serve = obs_sub.add_parser(
+        "serve", help="profile a synthetic serving run (paged engine)"
+    )
+    obs_serve.add_argument("--requests", type=int, default=8)
+    obs_serve.add_argument("--batch-slots", type=int, default=4)
+    obs_serve.add_argument("--max-new-tokens", type=int, default=8)
+    obs_serve.add_argument("--prompt-len", type=int, default=16)
+    obs_serve.add_argument("--quantize-kv", default=None, choices=("int8",),
+                           help="profile the int8-KV engine instead of f32")
+    obs_train = obs_sub.add_parser(
+        "train", help="profile a short synthetic training fit"
+    )
+    obs_train.add_argument("--steps", type=int, default=8)
+    obs_train.add_argument("--batch-size", type=int, default=16)
+    for p in (obs_serve, obs_train):
+        p.add_argument(
+            "--trace-dir", default="ddlt-obs",
+            help="output dir: device trace + merged.trace.json + "
+            "obs-metrics.jsonl (default ./ddlt-obs)",
+        )
 
     inter_p = sub.add_parser(
         "interactive",
@@ -742,6 +775,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_train(args, extra)
     if args.command == "serve":
         return _cmd_serve(args)
+    if args.command == "obs":
+        return _cmd_obs(args)
     if args.command == "storage":
         return _cmd_storage(args)
     if args.command in (
@@ -1283,9 +1318,26 @@ def _cmd_serve(args) -> int:
     scheduler = ContinuousBatchingScheduler(
         engine, eos_id=args.eos_id, max_new_tokens=args.max_new_tokens
     )
-    results, report = scheduler.run(
-        [Request(uid=uid, prompt=p) for uid, p in prompts]
-    )
+    reqs = [Request(uid=uid, prompt=p) for uid, p in prompts]
+    if args.trace_dir:
+        # obs mode: host spans (request lifecycle, prefill chunks, decode
+        # dispatch) + the jax.profiler device trace, merged onto one
+        # Chrome-trace timeline under --trace-dir
+        from distributeddeeplearning_tpu.obs import configure
+        from distributeddeeplearning_tpu.obs.profile import profile_and_merge
+
+        tracer = configure(enabled=False)  # enabled inside the window
+
+        def _serve_run():
+            with tracer.span("serve/run", requests=len(reqs)):
+                return scheduler.run(reqs)
+
+        (results, report), _, _, merged_path = profile_and_merge(
+            _serve_run, trace_dir=args.trace_dir, tracer=tracer
+        )
+        print(f"[serve] merged trace -> {merged_path}", file=sys.stderr)
+    else:
+        results, report = scheduler.run(reqs)
 
     from distributeddeeplearning_tpu.utils.virtual_pod import is_virtual_pod
 
@@ -1293,6 +1345,8 @@ def _cmd_serve(args) -> int:
     stats["platform"] = jax.default_backend()
     stats["virtual_pod"] = is_virtual_pod()
     stats["mesh_devices"] = n_dev if mesh is not None else 1
+    if args.trace_dir:
+        stats["trace_dir"] = args.trace_dir
     if args.synthetic:
         print(_json.dumps(stats))
     else:
@@ -1304,6 +1358,155 @@ def _cmd_serve(args) -> int:
             _json.dump(stats, f, indent=2)
             f.write("\n")
         print(f"[serve] report -> {args.report}", file=sys.stderr)
+    return 0
+
+
+def _cmd_obs(args) -> int:
+    """``ddlt obs {serve,train}`` — the profiling harness as a verb.
+
+    Wraps a short, self-contained run (synthetic traffic, tiny dims) in
+    the obs tracer + ``jax.profiler.trace``, merges the two timelines
+    onto one clock, snapshots the metrics registry, and prints a summary
+    JSON line.  The trace dir then holds:
+
+    - ``merged.trace.json`` — host spans + device profile, one file,
+      opens directly in chrome://tracing / Perfetto;
+    - ``obs-metrics.jsonl`` — the registry snapshot row(s);
+    - the raw xprof trace (``plugins/profile/...``) for xprof tooling.
+
+    For the real attribution artifact (f32-vs-int8 decode breakdown) use
+    ``bench.py --obs``; this verb is the quick "show me the timeline of
+    what this thing does" loop.
+    """
+    import json as _json
+    import os
+
+    import jax
+    import numpy as np
+
+    from distributeddeeplearning_tpu.obs import configure, get_registry
+    from distributeddeeplearning_tpu.obs.profile import (
+        profile_and_merge,
+        summarize_timeline,
+    )
+
+    os.makedirs(args.trace_dir, exist_ok=True)
+    tracer = configure(enabled=False)  # enabled inside the window
+
+    if args.obs_command == "serve":
+        import jax.numpy as jnp
+
+        from distributeddeeplearning_tpu.models.pipelined_transformer import (
+            init_params,
+        )
+        from distributeddeeplearning_tpu.serve import (
+            ContinuousBatchingScheduler,
+            PagedInferenceEngine,
+            synthetic_requests,
+        )
+
+        dims = dict(num_layers=2, d_model=64, num_heads=4, d_ff=128,
+                    vocab_size=257)
+        max_seq = args.prompt_len + args.max_new_tokens
+        params = init_params(jax.random.key(0), max_len=max_seq, **dims)
+        engine = PagedInferenceEngine(
+            params, num_heads=dims["num_heads"],
+            batch_slots=args.batch_slots, max_seq=max_seq,
+            cache_dtype=jnp.int8 if args.quantize_kv == "int8" else None,
+            rng=jax.random.key(1),
+        )
+        requests = synthetic_requests(
+            args.requests, vocab_size=dims["vocab_size"],
+            max_prompt=args.prompt_len,
+            rng=np.random.default_rng(0),
+        )
+
+        def run():
+            return ContinuousBatchingScheduler(
+                engine, max_new_tokens=args.max_new_tokens
+            ).run(requests)[1]
+
+    else:  # train
+        import itertools
+
+        import jax.numpy as jnp
+
+        from distributeddeeplearning_tpu.data.synthetic import (
+            SyntheticDataset,
+        )
+        from distributeddeeplearning_tpu.models import get_model
+        from distributeddeeplearning_tpu.parallel import (
+            MeshSpec,
+            create_mesh,
+        )
+        from distributeddeeplearning_tpu.train.loop import (
+            Trainer,
+            TrainerConfig,
+        )
+        from distributeddeeplearning_tpu.train.schedule import (
+            goyal_lr_schedule,
+        )
+        from distributeddeeplearning_tpu.train.state import (
+            create_train_state,
+            sgd_momentum,
+        )
+        from distributeddeeplearning_tpu.train.step import build_train_step
+
+        img = (32, 32, 3)
+        mesh = create_mesh(MeshSpec())
+        model = get_model("resnet18", num_classes=10, dtype=jnp.float32)
+        tx = sgd_momentum(goyal_lr_schedule(0.05, 1, steps_per_epoch=100))
+        state = create_train_state(
+            jax.random.key(0), model, (args.batch_size, *img), tx
+        )
+        step = build_train_step(mesh, state, compute_dtype=jnp.float32)
+        ds = SyntheticDataset(
+            length=args.batch_size * (args.steps + 2), image_shape=img,
+            num_classes=10,
+        )
+        trainer = Trainer(
+            mesh, step,
+            config=TrainerConfig(
+                epochs=1, steps_per_epoch=args.steps,
+                global_batch_size=args.batch_size, log_every=10**9,
+                prefetch=0,
+                obs_metrics_path=os.path.join(
+                    args.trace_dir, "obs-metrics.jsonl"
+                ),
+            ),
+        )
+
+        def run():
+            _, result = trainer.fit(
+                state, itertools.cycle(ds.batches(args.batch_size))
+            )
+            return result
+
+    def _windowed():
+        with tracer.span(f"obs/{args.obs_command}"):
+            return run()
+
+    _, _, merged, merged_path = profile_and_merge(
+        _windowed, trace_dir=args.trace_dir, tracer=tracer
+    )
+    snapshot_path = os.path.join(args.trace_dir, "obs-metrics.jsonl")
+    if args.obs_command != "train":
+        # train mode: the Trainer already appended one row per epoch via
+        # obs_metrics_path (same file) — a second write here would leave
+        # duplicate rows and double-count every epoch downstream
+        get_registry().write_snapshot(snapshot_path, mode=args.obs_command)
+    digest = summarize_timeline(merged, limit=20)
+    print(_json.dumps({
+        "mode": args.obs_command,
+        "merged_trace": merged_path,
+        "obs_metrics": snapshot_path,
+        "event_counts": digest["event_counts"],
+        "host_span_total_ms": digest["host_span_total_ms"],
+    }))
+    print(
+        f"[obs] open {merged_path} in chrome://tracing or "
+        "https://ui.perfetto.dev", file=sys.stderr,
+    )
     return 0
 
 

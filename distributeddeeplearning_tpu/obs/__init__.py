@@ -1,0 +1,51 @@
+"""Unified observability: tracing, metrics, on-device profiling.
+
+The package every layer reports through (ISSUE 6 / OBS_r11):
+
+- :mod:`obs.trace` — nested host spans + instant events with
+  ``jax.profiler.TraceAnnotation`` pass-through, exported as Chrome-trace
+  JSON; zero-sync and near-zero-cost when disabled (the hot-loop lint
+  enforces both);
+- :mod:`obs.registry` — counters, gauges and streaming-percentile
+  histograms (the ONE quantile implementation the scheduler and bench
+  artifacts route through), snapshotted to JSONL through the retry/fault
+  layer;
+- :mod:`obs.profile` — merges the ``jax.profiler`` device trace with the
+  host spans onto one clock, and measures per-phase decode breakdowns
+  (the QUANT_r10 int8-regression attribution);
+- :mod:`obs.schema` — artifact validation, so committed ``*_r*.json``
+  drift fails tier-1 instead of rotting.
+
+Entry points: ``ddlt obs {train,serve}``, ``ddlt serve --trace-dir`` and
+``bench.py --obs`` (the ``OBS_r{NN}.json`` artifact).
+"""
+
+from distributeddeeplearning_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+    summarize,
+)
+from distributeddeeplearning_tpu.obs.trace import (
+    Tracer,
+    configure,
+    get_tracer,
+    set_tracer,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Tracer",
+    "configure",
+    "get_registry",
+    "get_tracer",
+    "set_registry",
+    "set_tracer",
+    "summarize",
+]

@@ -1,0 +1,296 @@
+"""Metrics registry: counters, gauges and streaming-percentile histograms.
+
+The ONE quantile implementation every subsystem routes through — the serve
+scheduler's TTFT/TPOT/queue-wait percentiles, bench artifact latency
+tables, the trainer's epoch rollups — replacing the per-site ad-hoc meters
+(``utils/metrics.AverageMeter``, ``serve/scheduler._percentiles``,
+assorted ``np.percentile`` calls) that each invented their own keys and
+rounding.
+
+The histogram is a log-linear (HDR-style) bucket sketch: bounded memory
+(one int per occupied bucket), one ``record()`` is a couple of dict ops —
+cheap enough for a hot host loop — and percentiles carry a bounded
+RELATIVE error (default 1%, set by ``max_rel_err``).  Count/sum/min/max
+are exact, and reported percentiles are clamped to [min, max], so ``p99 >=
+p50`` and ``max`` is always the true max.
+
+Snapshots serialize the whole registry to a JSONL row — appended through
+the bounded-backoff retry helper and the ``DDLT_FAULTS`` ``io_error``
+injection point, so the observability channel survives the same storage
+chaos the checkpoint/metrics paths do, and rows written before a restart
+survive it (append-only file).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "summarize",
+    "get_registry",
+    "set_registry",
+]
+
+#: percentiles every summary reports (the artifact/ServeReport contract:
+#: p50/p99/mean/max were the pre-obs keys; p90 is the tail the serving
+#: papers quote between them)
+SUMMARY_PERCENTILES = (50.0, 90.0, 99.0)
+
+
+class Counter:
+    """Monotonic event count (requests served, anomalous steps, ...)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-value-wins scalar (occupancy, images/sec, free pages, ...)."""
+
+    __slots__ = ("name", "value", "updated_at")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: Optional[float] = None
+        self.updated_at: Optional[float] = None
+
+    def set(self, value: float) -> None:
+        self.value = float(value)  # sync-ok: host scalar coercion
+        self.updated_at = time.time()
+
+
+class Histogram:
+    """Streaming percentile sketch over non-negative samples.
+
+    Log-linear buckets: sample ``x`` lands in bucket
+    ``ceil(log(x) / log(1 + max_rel_err))``, so any percentile read back
+    from bucket boundaries is within ``max_rel_err`` (relative) of the
+    exact order statistic.  Values ``<= 0`` share one underflow bucket
+    (latencies are the target domain).  Memory is one int per occupied
+    bucket — bounded by the dynamic range, not the sample count.
+    """
+
+    __slots__ = (
+        "name", "max_rel_err", "_log_base", "_buckets",
+        "count", "total", "min", "max",
+    )
+
+    def __init__(self, name: str = "", max_rel_err: float = 0.01):
+        if not 0.0 < max_rel_err < 1.0:
+            raise ValueError(
+                f"max_rel_err must be in (0, 1), got {max_rel_err}"
+            )
+        self.name = name
+        self.max_rel_err = max_rel_err
+        self._log_base = math.log1p(max_rel_err)
+        self._buckets: Dict[int, int] = {}
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    # -- recording --------------------------------------------------------
+    def record(self, x: float) -> None:
+        # callers pass host scalars by contract — this coercion never
+        # touches a device value (lint-checked with that expectation)
+        x = float(x)  # sync-ok: host scalar coercion
+        if x > 0.0:
+            idx = math.ceil(math.log(x) / self._log_base)
+        else:
+            idx = None  # underflow bucket: zero / negative samples
+        self._buckets[idx] = self._buckets.get(idx, 0) + 1
+        self.count += 1
+        self.total += x
+        if x < self.min:
+            self.min = x
+        if x > self.max:
+            self.max = x
+
+    def record_many(self, xs: Iterable[float]) -> None:
+        for x in xs:
+            self.record(x)
+
+    # -- reading ----------------------------------------------------------
+    def _bucket_value(self, idx) -> float:
+        if idx is None:
+            return min(self.min, 0.0)
+        # geometric midpoint of the bucket's (lo, hi] bounds
+        hi = math.exp(idx * self._log_base)
+        return hi / math.sqrt(1.0 + self.max_rel_err)
+
+    def percentile(self, q: float) -> float:
+        """The q-th percentile (q in [0, 100]); 0.0 on an empty histogram."""
+        if not self.count:
+            return 0.0
+        if not 0.0 <= q <= 100.0:
+            raise ValueError(f"percentile {q} outside [0, 100]")
+        # rank follows numpy's 'higher' convention: on small counts the
+        # tail percentiles land on (or above) the interpolated value
+        # instead of collapsing toward the median — p99 of 8 samples is
+        # the 8th, not the 7th.  The bucket walk is monotone in q, so
+        # p99 >= p90 >= p50 by construction.
+        rank = q / 100.0 * (self.count - 1)
+        target = math.ceil(rank) + 1
+        seen = 0
+        # underflow bucket sorts first (None < every finite sample > 0)
+        keys = sorted(
+            self._buckets, key=lambda k: -math.inf if k is None else k
+        )
+        for idx in keys:
+            seen += self._buckets[idx]
+            if seen >= target:
+                v = self._bucket_value(idx)
+                return min(max(v, self.min), self.max)
+        return self.max  # pragma: no cover - walk always terminates above
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def summary(self, round_ndigits: int = 6) -> Dict[str, float]:
+        """The percentile block every latency field in the artifacts uses:
+        ``{"p50", "p90", "p99", "mean", "max"}`` (mean/max exact)."""
+        if not self.count:
+            return {
+                **{f"p{int(q)}": 0.0 for q in SUMMARY_PERCENTILES},
+                "mean": 0.0,
+                "max": 0.0,
+            }
+        out = {
+            f"p{int(q)}": round(self.percentile(q), round_ndigits)
+            for q in SUMMARY_PERCENTILES
+        }
+        out["mean"] = round(self.mean, round_ndigits)
+        out["max"] = round(self.max, round_ndigits)
+        return out
+
+    def merge(self, other: "Histogram") -> None:
+        if other._log_base != self._log_base:
+            raise ValueError("cannot merge histograms with different error bounds")
+        for idx, n in other._buckets.items():
+            self._buckets[idx] = self._buckets.get(idx, 0) + n
+        self.count += other.count
+        self.total += other.total
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"count": self.count, **self.summary()}
+
+
+def summarize(xs, max_rel_err: float = 0.01) -> Dict[str, float]:
+    """Percentile block of a finished sample list — the drop-in for the
+    scheduler's old ``_percentiles`` and any bench-side quantile math:
+    one histogram implementation, one key set."""
+    h = Histogram(max_rel_err=max_rel_err)
+    h.record_many(xs)
+    return h.summary()
+
+
+class MetricsRegistry:
+    """Named counters/gauges/histograms plus JSONL snapshotting.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create (idempotent by
+    name), so instrumentation sites don't coordinate construction.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self.snapshots_written = 0
+        self.snapshots_dropped = 0
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            if name not in self._counters:
+                self._counters[name] = Counter(name)
+            return self._counters[name]
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            if name not in self._gauges:
+                self._gauges[name] = Gauge(name)
+            return self._gauges[name]
+
+    def histogram(self, name: str, max_rel_err: float = 0.01) -> Histogram:
+        with self._lock:
+            if name not in self._histograms:
+                self._histograms[name] = Histogram(name, max_rel_err)
+            return self._histograms[name]
+
+    def snapshot(self, **extra: Any) -> Dict[str, Any]:
+        """One JSON-ready row of everything the process has recorded."""
+        with self._lock:
+            return {
+                "ts": time.time(),
+                "counters": {n: c.value for n, c in self._counters.items()},
+                "gauges": {
+                    n: g.value for n, g in self._gauges.items()
+                    if g.value is not None
+                },
+                "histograms": {
+                    n: h.snapshot() for n, h in self._histograms.items()
+                },
+                **extra,
+            }
+
+    def write_snapshot(self, path: str, **extra: Any) -> bool:
+        """Append one snapshot row to ``path`` (JSONL), best-effort.
+
+        Runs through the retry helper and the ``DDLT_FAULTS`` ``io_error``
+        hook — same contract as checkpoint/metrics writes: transient
+        storage failures retry, exhausted retries DROP the row (counted)
+        rather than killing the run.  Append-only, so rows written before
+        a crash/restart survive it.
+        """
+        from distributeddeeplearning_tpu.utils import faults as faults_mod
+        from distributeddeeplearning_tpu.utils.retry import retry_call
+
+        line = json.dumps(self.snapshot(**extra)) + "\n"
+
+        def _write() -> None:
+            faults_mod.get_plan().maybe_io_error("obs")
+            with open(path, "a") as f:
+                f.write(line)
+
+        try:
+            retry_call(
+                _write, retries=3, base_delay=0.05, max_delay=2.0,
+                description=f"obs snapshot ({path})",
+            )
+        except Exception:
+            self.snapshots_dropped += 1
+            return False
+        self.snapshots_written += 1
+        return True
+
+
+# -- process-global registry ----------------------------------------------
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    global _REGISTRY
+    _REGISTRY = registry
+    return registry

@@ -1,0 +1,170 @@
+"""Artifact schema validation: committed ``*_r*.json`` drift fails tier-1.
+
+Benchmark artifacts are the repo's evidence trail, and they rot silently:
+an emit-site refactor drops a key, the README keeps documenting it, and
+nobody notices until a comparison script crashes months later.  This
+module is the lightweight guard ``tests/test_obs.py`` runs over every
+committed revision artifact:
+
+- every ``*_r*.json`` must parse and be a non-empty JSON container;
+- any artifact carrying the bench-line contract (a ``metric`` key) must
+  carry ``value`` and ``unit`` too;
+- any latency percentile block (``ttft_s`` / ``decode_step_s`` /
+  ``queue_wait_s`` / ``tpot_s``) must contain numeric ``p50 <= p99`` —
+  the keys every consumer of the serving artifacts indexes;
+- ``OBS_*`` artifacts additionally validate against the full obs schema
+  (merged timeline digest + decode phase breakdown + regression
+  attribution), since the whole point of OBS_r11 is that downstream
+  work (ROADMAP Open item 2) can script against it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+__all__ = ["SchemaError", "validate_artifact", "validate_obs_payload"]
+
+#: latency blocks whose percentile keys are a cross-artifact contract
+PERCENTILE_BLOCKS = ("ttft_s", "decode_step_s", "queue_wait_s", "tpot_s")
+
+
+class SchemaError(ValueError):
+    """An artifact violates the documented schema."""
+
+
+def _check_percentile_blocks(node: Any, path: str, errors: List[str]) -> None:
+    if isinstance(node, dict):
+        for key, value in node.items():
+            where = f"{path}.{key}" if path else str(key)
+            if key in PERCENTILE_BLOCKS and isinstance(value, dict):
+                for pk in ("p50", "p99"):
+                    if not isinstance(value.get(pk), (int, float)):
+                        errors.append(
+                            f"{where}: missing/non-numeric {pk!r}"
+                        )
+                if (
+                    isinstance(value.get("p50"), (int, float))
+                    and isinstance(value.get("p99"), (int, float))
+                    and value["p99"] < value["p50"] - 1e-9
+                ):
+                    errors.append(f"{where}: p99 < p50")
+            _check_percentile_blocks(value, where, errors)
+    elif isinstance(node, list):
+        for i, item in enumerate(node):
+            _check_percentile_blocks(item, f"{path}[{i}]", errors)
+
+
+def validate_obs_payload(payload: Dict[str, Any]) -> None:
+    """Strict schema for the ``OBS_r{NN}.json`` artifact body."""
+    errors: List[str] = []
+
+    def require(cond: bool, msg: str) -> None:
+        if not cond:
+            errors.append(msg)
+
+    for key in ("metric", "value", "unit", "bench_revision", "platform",
+                "virtual_pod", "timeline", "decode_breakdown",
+                "regression_attribution"):
+        require(key in payload, f"missing top-level key {key!r}")
+
+    timeline = payload.get("timeline")
+    if isinstance(timeline, dict):
+        require(
+            isinstance(timeline.get("events"), list)
+            and len(timeline["events"]) > 0,
+            "timeline.events must be a non-empty list",
+        )
+        counts = timeline.get("event_counts")
+        require(
+            isinstance(counts, dict)
+            and isinstance(counts.get("host_spans"), int)
+            and counts["host_spans"] > 0,
+            "timeline.event_counts.host_spans must be a positive int "
+            "(the merge lost the host half)",
+        )
+        for ev in (timeline.get("events") or [])[:5]:
+            require(
+                isinstance(ev, dict)
+                and isinstance(ev.get("name"), str)
+                and ev.get("source") in ("host", "device")
+                and isinstance(ev.get("ts_ms"), (int, float))
+                and isinstance(ev.get("dur_ms"), (int, float)),
+                f"malformed timeline event {ev!r}",
+            )
+    else:
+        require(False, "timeline must be a dict")
+
+    breakdown = payload.get("decode_breakdown")
+    if isinstance(breakdown, dict):
+        require(
+            len(breakdown) >= 2,
+            "decode_breakdown needs at least two engine configs "
+            "(the f32-vs-int8 comparison)",
+        )
+        for name, bd in breakdown.items():
+            require(
+                isinstance(bd, dict)
+                and isinstance(bd.get("decode_step_ms"), (int, float))
+                and isinstance(bd.get("phases_ms"), dict)
+                and len(bd["phases_ms"]) >= 2,
+                f"decode_breakdown[{name!r}] missing decode_step_ms/"
+                "phases_ms",
+            )
+    else:
+        require(False, "decode_breakdown must be a dict")
+
+    attribution = payload.get("regression_attribution")
+    if isinstance(attribution, dict):
+        require(
+            isinstance(attribution.get("hottest_phase"), str),
+            "regression_attribution.hottest_phase must name a phase",
+        )
+        require(
+            isinstance(
+                attribution.get("hottest_phase_share_of_step_time"),
+                (int, float),
+            ),
+            "regression_attribution.hottest_phase_share_of_step_time "
+            "must be numeric",
+        )
+    else:
+        require(False, "regression_attribution must be a dict")
+
+    if errors:
+        raise SchemaError("; ".join(errors))
+
+
+def validate_artifact(path: str) -> Any:
+    """Validate one committed artifact file; returns the parsed JSON.
+
+    Raises :class:`SchemaError` with every violation found (not just the
+    first) so a drifted artifact reads as one actionable failure.
+    """
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except json.JSONDecodeError as exc:
+        raise SchemaError(f"{path}: not valid JSON ({exc})") from exc
+
+    if not isinstance(data, (dict, list)) or not data:
+        raise SchemaError(f"{path}: empty or non-container artifact")
+
+    errors: List[str] = []
+    if isinstance(data, dict) and "metric" in data:
+        for key in ("value", "unit"):
+            if key not in data:
+                errors.append(f"bench line missing {key!r} next to 'metric'")
+    _check_percentile_blocks(data, "", errors)
+
+    import os
+
+    if os.path.basename(path).startswith("OBS_") and isinstance(data, dict):
+        try:
+            validate_obs_payload(data)
+        except SchemaError as exc:
+            errors.append(str(exc))
+
+    if errors:
+        raise SchemaError(f"{path}: " + "; ".join(errors))
+    return data

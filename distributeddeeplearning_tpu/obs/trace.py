@@ -1,0 +1,251 @@
+"""Span-based host tracer: one timeline for train, serve and resilience.
+
+Five subsystems each grew their own stats channel (ServeReport counters,
+the COMMS overlap twin, RESILIENCE recovery accounting, roofline tables,
+watchdog stack dumps) with no way to put a train step's data wait, a serve
+request's prefill chunks and a preemption event on ONE clock.  This module
+is that clock: nested host-side spans plus instant events, exported as
+Chrome-trace JSON (``chrome://tracing`` / Perfetto open it directly), with
+``jax.profiler.TraceAnnotation`` pass-through so the same span names land
+inside the device profile and :mod:`.profile` can merge the two timelines.
+
+Design constraints (enforced by ``tests/test_hotloop_lint.py``):
+
+- **zero-sync**: nothing in the span path reads a device value — spans
+  time host wall-clock only, so instrumenting a hot loop can never
+  serialize dispatch;
+- **near-zero cost when disabled**: ``span()`` on a disabled tracer
+  returns a shared no-op context manager without reading the clock or
+  allocating an event — the hot paths stay hot with observability off
+  (the default).
+
+Usage::
+
+    tracer = get_tracer()                    # process-global, disabled
+    tracer.enable()                          # or configure(enabled=True)
+    with tracer.span("train/step", step=12):
+        ...
+    tracer.event("preempted", step=12)       # instant event
+    tracer.export("trace.json")              # Chrome trace JSON
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "Tracer",
+    "get_tracer",
+    "set_tracer",
+    "configure",
+]
+
+# Synthetic pid for host-side spans in the exported Chrome trace; device
+# traces use their own pids, so the merged view keeps the rows apart.
+HOST_PID = 1
+
+
+class _NullSpan:
+    """The disabled-tracer span: a shared, stateless no-op.
+
+    ``__enter__``/``__exit__`` do nothing — no clock read, no allocation —
+    so a disabled tracer's per-call cost is one attribute check plus
+    returning this singleton.
+    """
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span: records a Chrome ``"X"`` (complete) event on exit."""
+
+    __slots__ = ("_tracer", "_name", "_cat", "_args", "_t0", "_annotation")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args):
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._args = args
+        self._t0 = 0.0
+        self._annotation = None
+
+    def __enter__(self) -> "_Span":
+        tracer = self._tracer
+        if tracer._annotate:
+            # pass-through into the device profile: the SAME name shows up
+            # in the jax.profiler trace, which is what lets profile.py
+            # align the host and device clocks
+            ann = tracer._trace_annotation
+            if ann is not None:
+                self._annotation = ann(self._name)
+                self._annotation.__enter__()
+        self._t0 = time.perf_counter()
+        tracer._depth_local.depth = getattr(tracer._depth_local, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc) -> None:
+        t1 = time.perf_counter()
+        tracer = self._tracer
+        depth = getattr(tracer._depth_local, "depth", 1)
+        tracer._depth_local.depth = depth - 1
+        if self._annotation is not None:
+            self._annotation.__exit__(*exc)
+        args = dict(self._args) if self._args else {}
+        args["depth"] = depth - 1  # 0 = top-level: span nesting, testable
+        tracer._events.append(
+            {
+                "ph": "X",
+                "name": self._name,
+                "cat": self._cat,
+                "pid": HOST_PID,
+                "tid": threading.get_ident() & 0xFFFFFFFF,
+                "ts": (self._t0 - tracer._epoch_perf) * 1e6,
+                "dur": (t1 - self._t0) * 1e6,
+                "args": args,
+            }
+        )
+
+
+class Tracer:
+    """Nested host spans + instant events on one monotonic clock.
+
+    Thread-safe by construction: events append to one list (atomic under
+    the GIL) and nesting depth is tracked per thread, so the scheduler
+    loop, the trainer loop and the watchdog thread can all report into the
+    same tracer.
+    """
+
+    def __init__(self, *, enabled: bool = False, annotate: bool = True):
+        self._enabled = enabled
+        self._annotate_requested = annotate
+        self._annotate = False
+        self._trace_annotation = None
+        self._events: List[Dict[str, Any]] = []
+        self._depth_local = threading.local()
+        # epoch pair: perf_counter for span math, wall clock so merged
+        # timelines can be stamped in absolute time
+        self._epoch_perf = time.perf_counter()
+        self._epoch_wall = time.time()
+        if enabled:
+            self._resolve_annotation()
+
+    def _resolve_annotation(self) -> None:
+        """Bind ``jax.profiler.TraceAnnotation`` lazily — the registry and
+        schema halves of ``obs`` stay importable without jax."""
+        if not self._annotate_requested or self._trace_annotation is not None:
+            return
+        try:
+            from jax.profiler import TraceAnnotation
+
+            self._trace_annotation = TraceAnnotation
+            self._annotate = True
+        except Exception:  # pragma: no cover - jax always present in-repo
+            self._annotate = False
+
+    # -- control ----------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self) -> "Tracer":
+        self._enabled = True
+        self._resolve_annotation()
+        return self
+
+    def disable(self) -> "Tracer":
+        self._enabled = False
+        return self
+
+    def clear(self) -> None:
+        self._events = []
+
+    # -- recording --------------------------------------------------------
+    def span(self, name: str, cat: str = "host", **args):
+        """Context manager timing a host-side phase.  Disabled tracer:
+        returns the shared no-op span (no clock read, no allocation)."""
+        if not self._enabled:
+            return _NULL_SPAN
+        return _Span(self, name, cat, args)
+
+    def event(self, name: str, cat: str = "host", **args) -> None:
+        """Instant event (Chrome ``"i"``): watchdog trips, preemptions,
+        anomaly detections — point-in-time marks on the same timeline."""
+        if not self._enabled:
+            return
+        self._events.append(
+            {
+                "ph": "i",
+                "s": "t",  # thread-scoped instant
+                "name": name,
+                "cat": cat,
+                "pid": HOST_PID,
+                "tid": threading.get_ident() & 0xFFFFFFFF,
+                "ts": (time.perf_counter() - self._epoch_perf) * 1e6,
+                "args": dict(args),
+            }
+        )
+
+    # -- export -----------------------------------------------------------
+    @property
+    def events(self) -> List[Dict[str, Any]]:
+        return list(self._events)
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """The ``{"traceEvents": [...]}`` Chrome/Perfetto container, with
+        process metadata naming the host lane."""
+        meta = [
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": HOST_PID,
+                "args": {"name": "ddlt-host"},
+            }
+        ]
+        return {
+            "traceEvents": meta + list(self._events),
+            "displayTimeUnit": "ms",
+            "metadata": {
+                "tracer_epoch_unix_s": self._epoch_wall,
+                "clock": "perf_counter us since tracer epoch",
+            },
+        }
+
+    def export(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+            f.write("\n")
+        return path
+
+
+# -- process-global tracer (disabled by default) --------------------------
+
+_TRACER = Tracer(enabled=False)
+
+
+def get_tracer() -> Tracer:
+    """The process's tracer.  Disabled (no-op spans) until a driver —
+    ``ddlt obs``, ``bench.py --obs``, ``--trace-dir`` — enables it."""
+    return _TRACER
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    global _TRACER
+    _TRACER = tracer
+    return tracer
+
+
+def configure(*, enabled: bool, annotate: bool = True) -> Tracer:
+    """Install a fresh tracer with the given switches and return it."""
+    return set_tracer(Tracer(enabled=enabled, annotate=annotate))

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributeddeeplearning_tpu.obs.trace import get_tracer
 from distributeddeeplearning_tpu.models.pipelined_transformer import (
     forward_decode,
     forward_decode_paged,
@@ -345,12 +346,15 @@ class InferenceEngine:
             self.prefill_compiles += 1
         tokens = np.full((1, bucket), self.pad_id, np.int32)
         tokens[0, :length] = np.asarray(prompt, np.int32)
-        last, k, v = self._prefill_jit(
-            self.params, jnp.asarray(tokens), jnp.int32(length)
-        )
-        self._cache = self._insert_jit(
-            self._cache, k, v, jnp.int32(slot)
-        )
+        with get_tracer().span(
+            "serve/engine.prefill_dispatch", bucket=bucket
+        ):
+            last, k, v = self._prefill_jit(
+                self.params, jnp.asarray(tokens), jnp.int32(length)
+            )
+            self._cache = self._insert_jit(
+                self._cache, k, v, jnp.int32(slot)
+            )
         tok = self._sample_jit(last, jnp.int32(self._next_step()))
         return int(np.asarray(tok)[0])
 
@@ -360,13 +364,18 @@ class InferenceEngine:
         (fixed batch shape is what makes the step a single executable);
         the scheduler ignores their outputs and their cache writes stay
         masked behind the slot's position."""
-        toks, self._cache = self._decode_jit(
-            self.params,
-            self._cache,
-            jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(pos, jnp.int32),
-            jnp.int32(self._next_step()),
-        )
+        # dispatch span separate from the np.asarray readback below: on a
+        # merged timeline the gap between them IS the host-sync share of
+        # the decode step (the readback is the scheduler's one designed
+        # sync — it needs the token ids)
+        with get_tracer().span("serve/engine.decode_dispatch"):
+            toks, self._cache = self._decode_jit(
+                self.params,
+                self._cache,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(pos, jnp.int32),
+                jnp.int32(self._next_step()),
+            )
         return np.asarray(toks)
 
 
@@ -716,13 +725,16 @@ class PagedInferenceEngine:
         # these pages until the prompt is fully written
         table = np.full(self.blocks_per_slot, SCRATCH_PAGE, np.int32)
         table[: len(task.pages)] = task.pages
-        logits, self._cache = self._chunk_jit(
-            self.params,
-            self._cache,
-            jnp.asarray(tokens),
-            jnp.asarray(table),
-            jnp.int32(task.offset),
-        )
+        with get_tracer().span(
+            "serve/engine.chunk_dispatch", chunk=C, offset=task.offset
+        ):
+            logits, self._cache = self._chunk_jit(
+                self.params,
+                self._cache,
+                jnp.asarray(tokens),
+                jnp.asarray(table),
+                jnp.int32(task.offset),
+            )
         chunk_start = task.offset
         task.offset += real
         # publish freshly completed FULL prompt pages for prefix reuse —
@@ -779,11 +791,18 @@ class PagedInferenceEngine:
             jnp.asarray(self._block_tables),
             jnp.int32(self._next_step()),
         )
-        if self.capture_logits:
-            toks, logits, self._cache = self._decode_jit(*args, True)
+        logits = None
+        with get_tracer().span("serve/engine.decode_dispatch"):
+            if self.capture_logits:
+                toks, logits, self._cache = self._decode_jit(*args, True)
+            else:
+                toks, self._cache = self._decode_jit(*args, False)
+        # probe readback OUTSIDE the dispatch span (same contract as the
+        # dense engine): the logits device->host sync must not be billed
+        # to dispatch, or the dispatch-vs-readback gap on the merged
+        # timeline reads as ~0 exactly when capture_logits is on
+        if logits is not None:
             self.last_logits = np.asarray(logits)
-        else:
-            toks, self._cache = self._decode_jit(*args, False)
         return np.asarray(toks)
 
     def release(self, slot: int) -> None:

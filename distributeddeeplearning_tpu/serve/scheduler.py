@@ -28,11 +28,19 @@ What it records is the whole point of serving benchmarks:
 - per-request TTFT (arrival → first token, queue wait included — the
   number a user feels) and queue wait (arrival → admission) separately,
   so scheduler-induced latency is visible apart from prefill latency,
-- per-decode-step latency (≈ inter-token latency at full occupancy),
+- per-request TPOT (time per output token after the first — the
+  steady-state streaming rate) and per-decode-step latency (≈ inter-token
+  latency at full occupancy),
 - aggregate generated tokens/s and mean slot occupancy (how close the
   engine runs to its throughput ceiling),
 - ``prefill_compiles``: prefill shapes compiled DURING the run (each one
   was a mid-run jit stall; warmup should drive it to 0).
+
+Every percentile block routes through the obs histogram
+(:func:`..obs.registry.summarize`), the run emits request-lifecycle
+spans/events on the obs tracer (no-ops unless a driver enabled it), and
+aggregate counters/histograms feed the process metrics registry once per
+``run()``.
 """
 
 from __future__ import annotations
@@ -44,6 +52,8 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from distributeddeeplearning_tpu.obs.registry import get_registry, summarize
+from distributeddeeplearning_tpu.obs.trace import get_tracer
 from distributeddeeplearning_tpu.serve.engine import InferenceEngine
 
 
@@ -100,6 +110,10 @@ class ServeReport:
     # arrival -> admission percentiles: the scheduler-induced share of
     # TTFT, separated so queueing can't masquerade as prefill latency
     queue_wait_s: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # per-request time-per-output-token, (total - ttft) / (tokens - 1):
+    # the steady-state latency a streaming client feels after the first
+    # token (requests with < 2 tokens have no inter-token gap to measure)
+    tpot_s: Dict[str, float] = dataclasses.field(default_factory=dict)
     # prefill shapes compiled during THIS run (mid-run jit stalls)
     prefill_compiles: int = 0
     kv_layout: str = "dense"
@@ -156,16 +170,11 @@ def synthetic_requests(
     ]
 
 
-def _percentiles(xs: Sequence[float]) -> Dict[str, float]:
-    if not xs:
-        return {"p50": 0.0, "p99": 0.0, "mean": 0.0, "max": 0.0}
-    a = np.asarray(xs, np.float64)
-    return {
-        "p50": round(float(np.percentile(a, 50)), 6),
-        "p99": round(float(np.percentile(a, 99)), 6),
-        "mean": round(float(a.mean()), 6),
-        "max": round(float(a.max()), 6),
-    }
+# Percentile blocks route through the ONE streaming-histogram
+# implementation in obs.registry (1% bounded relative error, exact
+# mean/max) — the pre-obs per-site np.percentile math is gone, so every
+# artifact's p50/p90/p99 means the same thing.
+_percentiles = summarize
 
 
 class ContinuousBatchingScheduler:
@@ -212,6 +221,10 @@ class ContinuousBatchingScheduler:
         engine = self.engine
         slots = engine.batch_slots
         chunked = getattr(engine, "chunked_prefill", False)
+        # one trace clock for the whole request lifecycle: queue ->
+        # prefill chunks -> decode steps -> completion (obs/trace.py;
+        # no-op spans when tracing is disabled, which is the default)
+        trace = get_tracer()
         # duck-typed engines (test fakes) may not implement the release
         # verb; dense engines no-op it anyway
         release = getattr(engine, "release", lambda _slot: None)
@@ -271,6 +284,10 @@ class ContinuousBatchingScheduler:
             finish_reasons[reason] = finish_reasons.get(reason, 0) + 1
             if reason == "error":
                 error_count += 1
+            trace.event(
+                "serve/request_complete", uid=st.req.uid, reason=reason,
+                tokens=len(st.generated), ttft_s=st.ttft_s,
+            )
             del active[slot]
             release(slot)  # paged: pages back to the pool
             free.append(slot)
@@ -304,6 +321,9 @@ class ContinuousBatchingScheduler:
             finish_reasons[reason] = finish_reasons.get(reason, 0) + 1
             if reason == "error":
                 error_count += 1
+            trace.event(
+                "serve/request_failed", uid=req.uid, reason=reason,
+            )
 
         capped = False
         while pending or active or prefilling:
@@ -344,7 +364,13 @@ class ContinuousBatchingScheduler:
                 queue_wait = round(time.perf_counter() - t_start, 6)
                 if chunked:
                     try:
-                        task = engine.prefill_begin(slot, req.prompt, budget)
+                        with trace.span(
+                            "serve/admit", uid=req.uid,
+                            prompt_len=len(req.prompt),
+                        ):
+                            task = engine.prefill_begin(
+                                slot, req.prompt, budget
+                            )
                     except Exception as exc:  # noqa: BLE001 — per-request
                         release(slot)
                         fail_request(req, exc, queue_wait)
@@ -353,7 +379,11 @@ class ContinuousBatchingScheduler:
                     prefilling.append((task, req, budget, queue_wait))
                     continue
                 try:
-                    first = engine.prefill(slot, req.prompt)
+                    with trace.span(
+                        "serve/prefill", uid=req.uid,
+                        prompt_len=len(req.prompt),
+                    ):
+                        first = engine.prefill(slot, req.prompt)
                 except Exception as exc:  # noqa: BLE001 — isolate per request
                     fail_request(req, exc, queue_wait)
                     free.append(slot)
@@ -378,7 +408,11 @@ class ContinuousBatchingScheduler:
             if prefilling:
                 task, req, budget, queue_wait = prefilling[0]
                 try:
-                    first = engine.prefill_step(task)
+                    with trace.span(
+                        "serve/prefill_chunk", uid=req.uid,
+                        offset=task.offset,
+                    ):
+                        first = engine.prefill_step(task)
                 except Exception as exc:  # noqa: BLE001 — per-request
                     prefilling.popleft()
                     release(task.slot)
@@ -411,7 +445,8 @@ class ContinuousBatchingScheduler:
             occupancy.append(len(active) / slots)
             t0 = time.perf_counter()
             try:
-                out = engine.decode(tokens_buf, pos_buf)
+                with trace.span("serve/decode_step", active=len(active)):
+                    out = engine.decode(tokens_buf, pos_buf)
             except Exception as exc:  # noqa: BLE001
                 # The decode step is batch-wide: a raise poisons every
                 # ACTIVE slot's cache position, so those requests complete
@@ -453,6 +488,13 @@ class ContinuousBatchingScheduler:
 
         wall = time.perf_counter() - t_start
         generated = sum(len(r.tokens) for r in results)
+        # steady-state streaming latency per request: the inter-token gap
+        # after the first token landed (only measurable past 2 tokens)
+        tpot = [
+            (r.total_s - r.ttft_s) / (len(r.tokens) - 1)
+            for r in results
+            if len(r.tokens) >= 2 and r.finish_reason != "cancelled"
+        ]
         report = ServeReport(
             requests=n_requests,
             batch_slots=slots,
@@ -472,6 +514,7 @@ class ContinuousBatchingScheduler:
                 [r.queue_wait_s for r in results if r.finish_reason
                  not in ("cancelled",)]
             ),
+            tpot_s=_percentiles(tpot),
             prefill_compiles=(
                 getattr(engine, "prefill_compiles", 0) - compiles_before
             ),
@@ -491,5 +534,25 @@ class ContinuousBatchingScheduler:
                 if hasattr(engine, "kv_bytes_peak")
                 else 0
             ),
+        )
+        # end-of-run rollup into the process metrics registry (one
+        # record_many per stream, NOT per step — the hot loop stays hot):
+        # cross-run aggregates land in `ddlt obs` / bench snapshots
+        reg = get_registry()
+        reg.counter("serve.requests").inc(n_requests)
+        reg.counter("serve.generated_tokens").inc(generated)
+        reg.counter("serve.errors").inc(error_count)
+        # cancelled/errored/step_cap-cut requests never produced a first
+        # token and carry a hardcoded ttft_s=0.0 — recording them would
+        # drag the cross-run histogram toward 0 on every smoke or fault
+        # run (tpot and queue_wait above filter failures too)
+        reg.histogram("serve.ttft_s").record_many(
+            [r.ttft_s for r in results if r.tokens]
+        )
+        reg.histogram("serve.tpot_s").record_many(tpot)
+        reg.histogram("serve.decode_step_s").record_many(step_times)
+        reg.gauge("serve.tokens_per_sec").set(report.tokens_per_sec)
+        reg.gauge("serve.slot_occupancy_mean").set(
+            report.slot_occupancy_mean
         )
         return results, report

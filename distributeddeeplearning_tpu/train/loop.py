@@ -27,6 +27,8 @@ from typing import Any, Callable, Dict, Iterator, Optional
 import jax
 import numpy as np
 
+from distributeddeeplearning_tpu.obs.registry import get_registry
+from distributeddeeplearning_tpu.obs.trace import get_tracer
 from distributeddeeplearning_tpu.parallel.distributed import is_primary
 from distributeddeeplearning_tpu.parallel.sharding import shard_batch
 from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
@@ -214,6 +216,12 @@ class TrainerConfig:
     # after the first step of each epoch (compile excluded) and disarms
     # across eval/checkpoint phases.  None = off.
     step_deadline_s: Optional[float] = None
+    # ---- observability (obs/) ------------------------------------------
+    # Append a metrics-registry snapshot (counters/gauges/histograms as
+    # one JSONL row) here at every epoch boundary, primary process only.
+    # Writes go through the retry layer + DDLT_FAULTS io_error hook, same
+    # as the metrics log; append-only, so rows survive restarts.
+    obs_metrics_path: Optional[str] = None
 
 
 def _drain_bounded(batches: Iterator, limit, cap: int) -> list:
@@ -398,6 +406,11 @@ class Trainer:
                         raise
                     rollbacks += 1
                     detector = AnomalyDetector(cfg.anomaly_max_consecutive)
+                    get_tracer().event(
+                        "resilience/rollback", cat="resilience",
+                        step=exc.step,
+                        to_step=self.checkpointer.latest_step(),
+                    )
                     logger.warning(
                         "anomaly abort at step %s — rolling back to "
                         "checkpoint step %s (%d/%d rollbacks)",
@@ -430,6 +443,9 @@ class Trainer:
         checkpoint, then PreemptionError (→ exit 75 under the runner)."""
         if watchdog is not None:
             watchdog.pause()
+        get_tracer().event(
+            "resilience/preempted", cat="resilience", step=step
+        )
         if self.checkpointer is not None:
             logger.warning(
                 "preemption at step %d — writing emergency checkpoint", step
@@ -437,8 +453,11 @@ class Trainer:
             # save() copies device→host synchronously; wait() drains the
             # background write.  Both must land BEFORE the resumable exit:
             # the grace window is short and the checkpoint IS the recovery.
-            self.checkpointer.save(step, state)
-            self.checkpointer.wait()
+            with get_tracer().span(
+                "train/emergency_checkpoint", cat="resilience", step=step
+            ):
+                self.checkpointer.save(step, state)
+                self.checkpointer.wait()
             logger.warning("emergency checkpoint at step %d complete", step)
         raise PreemptionError(
             f"preempted at step {step} (emergency checkpoint "
@@ -452,6 +471,11 @@ class Trainer:
         plan=None,
     ) -> tuple:
         cfg = self.config
+        # one tracer for the whole fit: train-side spans (data wait / step
+        # / checkpoint) land on the same timeline as serve and resilience
+        # events.  Disabled (the default) = shared no-op spans, no clock
+        # reads — the hot-loop lint pins the loop body sync-free either way.
+        trace = get_tracer()
         tracker = ExamplesPerSecondTracker(
             global_batch_size=cfg.global_batch_size,
             every_n_steps=cfg.log_every,
@@ -495,11 +519,13 @@ class Trainer:
                 if profile_pending and global_step >= profile_start:
                     jax.profiler.start_trace(cfg.profile_dir)
                     profile_active, profile_pending = True, False
-                host_batch = next(train_batches)
+                with trace.span("train/data_wait", step=true_step):
+                    host_batch = next(train_batches)
                 if plan:
                     host_batch = plan.poison_batch(true_step, host_batch)
-                batch = shard_batch(self.mesh, host_batch)
-                state, metrics = self.train_step(state, batch)
+                with trace.span("train/step", step=true_step):
+                    batch = shard_batch(self.mesh, host_batch)
+                    state, metrics = self.train_step(state, batch)
                 anomalous = False
                 if detector is not None:
                     # One host sync per step — the price of reacting to a
@@ -534,7 +560,7 @@ class Trainer:
                     jax.block_until_ready(acc)
                 tracker.after_step()
                 if watchdog is not None:
-                    watchdog.tick()
+                    watchdog.tick(true_step)
                 total_images += cfg.global_batch_size
                 global_step += 1
                 if profile_active and global_step >= (
@@ -558,7 +584,8 @@ class Trainer:
                     # save() copies device→host synchronously, so the next
                     # step's donation cannot clobber the saved buffers; the
                     # serialize/write happens on orbax's background thread.
-                    self.checkpointer.save(true_step, state)
+                    with trace.span("train/checkpoint", step=true_step):
+                        self.checkpointer.save(true_step, state)
                 if guard is not None:
                     if plan:
                         plan.maybe_preempt(true_step, guard)
@@ -598,7 +625,10 @@ class Trainer:
             self.tb.scalars("train", train_metrics, epoch)
 
             if self.eval_step is not None and eval_batches_factory is not None:
-                eval_metrics = self.evaluate(state, eval_batches_factory())
+                with trace.span("train/eval", epoch=epoch + 1):
+                    eval_metrics = self.evaluate(
+                        state, eval_batches_factory()
+                    )
                 if is_primary():
                     logger.info(
                         "epoch %d validation: %s",
@@ -622,8 +652,33 @@ class Trainer:
                 row["includes_compile"] = True
             self.metrics_log.append(row)
 
+            # per-epoch rollup into the obs registry (never per step): the
+            # same counters/gauges the serve path feeds, one process view
+            reg = get_registry()
+            reg.counter("train.steps").inc(steps_this_epoch)
+            reg.counter("train.epochs").inc()
+            if anomalous_this_epoch:
+                reg.counter("train.anomalous_steps").inc(
+                    anomalous_this_epoch
+                )
+            reg.gauge("train.images_per_second").set(
+                row["images_per_second"]
+            )
+            if "loss" in train_metrics:
+                reg.gauge("train.loss").set(train_metrics["loss"])
+            reg.histogram("train.epoch_train_wall_s").record(
+                epoch_train_wall
+            )
+            if cfg.obs_metrics_path and is_primary():
+                reg.write_snapshot(cfg.obs_metrics_path, epoch=epoch + 1)
+
             if self.checkpointer is not None:
-                self.checkpointer.save((epoch + 1) * cfg.steps_per_epoch, state)
+                with trace.span(
+                    "train/checkpoint", step=(epoch + 1) * cfg.steps_per_epoch
+                ):
+                    self.checkpointer.save(
+                        (epoch + 1) * cfg.steps_per_epoch, state
+                    )
 
         wall = time.monotonic() - train_t0
         self.tb.flush()

@@ -30,6 +30,8 @@ import threading
 import time
 from typing import Callable, Optional, Tuple
 
+from distributeddeeplearning_tpu.obs.trace import get_tracer
+
 logger = logging.getLogger("ddlt.resilience")
 
 RESUMABLE_EXIT_CODE = 75  # EX_TEMPFAIL: checkpointed, restart me
@@ -115,11 +117,18 @@ class PreemptionGuard:
                 raise KeyboardInterrupt
         self.reason = f"signal {signal.Signals(signum).name}"
         self._flag.set()
+        get_tracer().event(
+            "resilience/preemption_signal", cat="resilience",
+            reason=self.reason,
+        )
 
     def trigger(self, reason: str = "triggered") -> None:
         """Programmatic preemption (fault injection, tests)."""
         self.reason = reason
         self._flag.set()
+        get_tracer().event(
+            "resilience/preemption_signal", cat="resilience", reason=reason
+        )
 
     def preempted(self) -> bool:
         return self._flag.is_set()
@@ -173,12 +182,22 @@ class AnomalyDetector:
             return False
         self.total += 1
         self.consecutive += 1
+        # an instant event on the obs timeline, not just a stderr line:
+        # anomaly trips line up against the steps/checkpoints around them
+        get_tracer().event(
+            "resilience/anomalous_step", cat="resilience", step=step,
+            loss=repr(loss), consecutive=self.consecutive,
+        )
         logger.warning(
             "anomalous step %d (loss=%s, grad_norm=%s): update skipped "
             "(%d consecutive, %d total)",
             step, loss, grad_norm, self.consecutive, self.total,
         )
         if self.consecutive >= self.max_consecutive:
+            get_tracer().event(
+                "resilience/anomaly_abort", cat="resilience", step=step,
+                consecutive=self.consecutive,
+            )
             raise AnomalyError(
                 f"{self.consecutive} consecutive non-finite steps "
                 f"(last: step {step}, loss={loss})",
@@ -241,6 +260,7 @@ class StepWatchdog:
         self._poll_s = poll_s if poll_s is not None else min(deadline_s / 4, 1.0)
         self._stream = stream
         self._last_tick: Optional[float] = None
+        self._last_step: Optional[int] = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -254,10 +274,14 @@ class StepWatchdog:
             self._thread.start()
         return self
 
-    def tick(self) -> None:
-        """A step completed; reset (and arm) the deadline."""
+    def tick(self, step: Optional[int] = None) -> None:
+        """A step completed; reset (and arm) the deadline.  ``step`` gives
+        the timeout report (and its trace event) the last step that made
+        progress — the first thing a hang post-mortem asks."""
         with self._lock:
             self._last_tick = time.monotonic()
+            if step is not None:
+                self._last_step = step
 
     def pause(self) -> None:
         """Disarm until the next tick (eval, checkpoint, epoch boundary)."""
@@ -280,9 +304,20 @@ class StepWatchdog:
             if elapsed <= self.deadline_s:
                 continue
             self.fired = True
+            with self._lock:
+                last_step = self._last_step
+            # timeline first, stderr second: the trace event carries the
+            # last-progressed step + timestamps so the hang shows up ON
+            # the exported timeline next to whatever it was waiting on
+            get_tracer().event(
+                "resilience/watchdog_fired", cat="resilience",
+                step=last_step, stalled_s=round(elapsed, 3),
+                deadline_s=self.deadline_s,
+            )
             stream = self._stream if self._stream is not None else sys.stderr
             print(
                 f"ddlt watchdog: no step progress for {elapsed:.1f}s "
+                f"since step {last_step} "
                 f"(deadline {self.deadline_s}s) — dumping all thread stacks",
                 file=stream,
             )

@@ -19,7 +19,12 @@ import jax.numpy as jnp
 
 
 class AverageMeter:
-    """Tracks current value, running sum, and average of a scalar stream."""
+    """Tracks current value, running sum, and average of a scalar stream.
+
+    Superseded for new code by :mod:`..obs.registry` (``Gauge`` for
+    last-value, ``Histogram`` for distributions — which also gives
+    streaming p50/p90/p99); kept for the reference-parity call sites.
+    """
 
     def __init__(self, name: str = ""):
         self.name = name

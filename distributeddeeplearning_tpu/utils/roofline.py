@@ -54,6 +54,16 @@ def _arg(args: Dict[str, Any], keys) -> Optional[float]:
     return None
 
 
+def load_trace_events(trace_file: str) -> List[Dict[str, Any]]:
+    """Raw ``traceEvents`` list of one xprof chrome-trace file (.gz or
+    plain) — the shared loader under :func:`device_op_events` and the obs
+    timeline merge (``obs/profile.py``)."""
+    opener = gzip.open if trace_file.endswith(".gz") else open
+    with opener(trace_file, "rt") as f:
+        trace = json.load(f)
+    return list(trace.get("traceEvents", []))
+
+
 def device_op_events(trace_file: str) -> List[Dict[str, Any]]:
     """Complete ("X") events that look like device HLO ops: have a duration
     and an XLA cost-model byte count in their args.
@@ -65,17 +75,15 @@ def device_op_events(trace_file: str) -> List[Dict[str, Any]]:
     ``"/device:TPU:0 stream#1"``), the event also carries ``pid_name`` so
     the analyzer can regroup pids that are really lanes of ONE device.
     """
-    opener = gzip.open if trace_file.endswith(".gz") else open
-    with opener(trace_file, "rt") as f:
-        trace = json.load(f)
+    events = load_trace_events(trace_file)
     pid_names: Dict[Any, str] = {}
-    for ev in trace.get("traceEvents", []):
+    for ev in events:
         if ev.get("ph") == "M" and ev.get("name") == "process_name":
             name = (ev.get("args") or {}).get("name")
             if name:
                 pid_names[ev.get("pid", 0)] = str(name)
     out = []
-    for ev in trace.get("traceEvents", []):
+    for ev in events:
         if ev.get("ph") != "X" or not ev.get("dur"):
             continue
         args = ev.get("args") or {}

@@ -31,10 +31,15 @@ class Timer:
         report: Optional[Callable[[str], None]] = None,
         prefix: Optional[str] = None,
         round_ndigits: int = 4,
+        histogram=None,
     ):
         self._report = report
         self._prefix = prefix
         self._round = round_ndigits
+        # obs bridge: an obs.registry.Histogram (or anything with
+        # .record(seconds)) that every stop() feeds — one timed phase
+        # becomes a streaming percentile series for free
+        self._histogram = histogram
         self._start: Optional[float] = None
         self._stop: Optional[float] = None
 
@@ -47,6 +52,8 @@ class Timer:
         if self._start is None:
             raise RuntimeError("Timer.stop() called before start()")
         self._stop = time.monotonic()
+        if self._histogram is not None:
+            self._histogram.record(self.elapsed)
         if self._report is not None:
             label = self._prefix or "elapsed"
             self._report(f"{label}: {round(self.elapsed, self._round)}s")
@@ -76,6 +83,7 @@ class Timer:
                 self._report,
                 prefix=self._prefix or fn.__name__,
                 round_ndigits=self._round,
+                histogram=self._histogram,
             ):
                 return fn(*args, **kwargs)
 

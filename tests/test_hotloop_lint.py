@@ -129,3 +129,109 @@ def test_step_builders_have_no_host_sync_tokens():
                 f"host-sync token inside jitted step builder "
                 f"{fn.__name__}: {line.strip()!r}"
             )
+
+
+# --- obs instrumentation (PR 6) ------------------------------------------
+# The tracer lives INSIDE both hot loops now, so it gets the same
+# treatment: its hot API must be sync-free, the instrumented regions must
+# actually be instrumented (a silent revert would pass the greps above),
+# and flipping the tracer on must not change what XLA compiled.
+
+
+def test_tracer_hot_api_has_no_sync_tokens():
+    """Everything on the span/event/record hot path is pure host
+    bookkeeping — no device reads, ever (zero-sync by construction)."""
+    from distributeddeeplearning_tpu.obs import registry as reg_mod
+    from distributeddeeplearning_tpu.obs import trace as trace_mod
+
+    hot = (
+        trace_mod.Tracer.span,
+        trace_mod.Tracer.event,
+        trace_mod._Span.__enter__,
+        trace_mod._Span.__exit__,
+        trace_mod._NullSpan.__enter__,
+        trace_mod._NullSpan.__exit__,
+        reg_mod.Histogram.record,
+        reg_mod.Counter.inc,
+        reg_mod.Gauge.set,
+    )
+    for fn in hot:
+        for line in inspect.getsource(fn).splitlines():
+            if MARKER in line:  # documented host-scalar coercions
+                continue
+            code = line.split("#", 1)[0]
+            assert not BANNED.search(code), (
+                f"host-sync token in obs hot API {fn.__qualname__}: "
+                f"{line.strip()!r}"
+            )
+
+
+def test_hot_loops_are_instrumented():
+    """The tracer calls inside the two hot loops are load-bearing (the
+    OBS timeline is built from them); the sync-lint above would not
+    notice them silently disappearing."""
+    assert any(
+        "trace.span(" in line for line in _step_loop_body()
+    ), "Trainer step loop lost its obs spans"
+    assert any(
+        "trace.span(" in line for line in _serve_loop_body()
+    ), "serve decode loop lost its obs spans"
+
+
+def test_disabled_then_enabled_tracer_adds_no_jit_recompiles():
+    """Tracing is host-side only: enabling it mid-process must not grow
+    any jitted executable cache (a tracer arg leaking into a jit
+    signature would recompile every program and stall the hot path)."""
+    import jax
+    import numpy as np
+
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        init_params,
+    )
+    from distributeddeeplearning_tpu.obs import trace as trace_mod
+    from distributeddeeplearning_tpu.serve import (
+        ContinuousBatchingScheduler,
+        PagedInferenceEngine,
+        Request,
+    )
+
+    params = init_params(
+        jax.random.key(0), num_layers=2, d_model=32, num_heads=2,
+        d_ff=64, vocab_size=97, max_len=32,
+    )
+    engine = PagedInferenceEngine(
+        params, num_heads=2, batch_slots=2, max_seq=32, page_size=8,
+        prefill_chunk=8, rng=jax.random.key(1),
+    )
+    rng = np.random.default_rng(0)
+
+    def run():
+        reqs = [
+            Request(uid=f"r{i}", prompt=rng.integers(1, 97, 6).tolist())
+            for i in range(3)
+        ]
+        ContinuousBatchingScheduler(engine, max_new_tokens=4).run(reqs)
+
+    trace_mod.set_tracer(trace_mod.Tracer(enabled=False))
+    try:
+        run()  # compiles every shape with tracing OFF
+        sizes_off = (
+            engine._decode_jit._cache_size(),
+            engine._chunk_jit._cache_size(),
+            engine.prefill_compiles,
+        )
+        trace_mod.set_tracer(
+            trace_mod.Tracer(enabled=True, annotate=False)
+        )
+        run()  # identical shapes with tracing ON
+        sizes_on = (
+            engine._decode_jit._cache_size(),
+            engine._chunk_jit._cache_size(),
+            engine.prefill_compiles,
+        )
+    finally:
+        trace_mod.set_tracer(trace_mod.Tracer(enabled=False))
+    assert sizes_on == sizes_off, (
+        f"enabling the tracer changed compiled-program counts: "
+        f"{sizes_off} -> {sizes_on}"
+    )

@@ -1,0 +1,386 @@
+"""Observability layer: tracer, registry, profiling merge, artifacts.
+
+Covers the OBS_r11 contract:
+
+- span nesting + Chrome-trace export schema round-trip (and the merge
+  onto a device trace's clock);
+- streaming-histogram percentile accuracy against numpy quantiles;
+- registry snapshots surviving injected storage faults AND a restart
+  (append-only JSONL through the retry layer);
+- the scheduler routing its percentile/TPOT blocks through obs, with
+  request-lifecycle events on the timeline;
+- ``bench.py --obs --steps-cap`` CPU smoke under pytest-timeout;
+- schema validation of EVERY committed ``*_r*.json`` artifact, so
+  artifact drift fails tier-1 instead of rotting silently.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.obs.registry import (
+    Histogram,
+    MetricsRegistry,
+    summarize,
+)
+from distributeddeeplearning_tpu.obs.trace import Tracer
+from distributeddeeplearning_tpu.utils import faults
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- tracer ---------------------------------------------------------------
+
+def test_span_nesting_and_depth():
+    t = Tracer(enabled=True, annotate=False)
+    with t.span("outer", step=1):
+        with t.span("inner"):
+            pass
+        with t.span("inner2"):
+            pass
+    spans = {e["name"]: e for e in t.events}
+    assert spans["outer"]["args"]["depth"] == 0
+    assert spans["inner"]["args"]["depth"] == 1
+    assert spans["inner2"]["args"]["depth"] == 1
+    assert spans["outer"]["args"]["step"] == 1
+    # time containment: children start after and end before the parent
+    for child in ("inner", "inner2"):
+        assert spans[child]["ts"] >= spans["outer"]["ts"]
+        assert (
+            spans[child]["ts"] + spans[child]["dur"]
+            <= spans["outer"]["ts"] + spans["outer"]["dur"] + 1.0
+        )
+
+
+def test_chrome_export_roundtrip(tmp_path):
+    t = Tracer(enabled=True, annotate=False)
+    with t.span("phase", kind="test"):
+        pass
+    t.event("mark", step=7)
+    path = t.export(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        loaded = json.load(f)
+    events = loaded["traceEvents"]
+    # process metadata names the host lane
+    meta = [e for e in events if e.get("ph") == "M"]
+    assert any(
+        e["name"] == "process_name"
+        and e["args"]["name"] == "ddlt-host"
+        for e in meta
+    )
+    xs = [e for e in events if e.get("ph") == "X"]
+    instants = [e for e in events if e.get("ph") == "i"]
+    assert xs[0]["name"] == "phase" and xs[0]["args"]["kind"] == "test"
+    assert instants[0]["name"] == "mark" and instants[0]["args"]["step"] == 7
+    for e in xs:
+        assert e["dur"] >= 0 and e["ts"] >= 0
+
+
+def test_disabled_tracer_records_nothing_and_reuses_null_span():
+    t = Tracer(enabled=False)
+    s1 = t.span("a", big_arg=list(range(10)))
+    s2 = t.span("b")
+    with s1:
+        pass
+    t.event("never")
+    assert s1 is s2  # the shared no-op: no per-call allocation
+    assert t.events == []
+
+
+def test_merge_host_device_aligns_clocks(tmp_path):
+    from distributeddeeplearning_tpu.obs.profile import merge_host_device
+
+    t = Tracer(enabled=True, annotate=False)
+    with t.span("shared_phase"):
+        pass
+    host_ts = t.events[0]["ts"]
+    # synthetic xprof trace: the same span name at a different clock
+    # origin, plus a device op — the merge must shift both by the offset
+    trace_dir = tmp_path / "plugins" / "profile" / "run1"
+    trace_dir.mkdir(parents=True)
+    device = {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 7,
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 7, "tid": 1, "ts": 5000.0, "dur": 10.0,
+             "name": "shared_phase"},
+            {"ph": "X", "pid": 7, "tid": 1, "ts": 5002.0, "dur": 3.0,
+             "name": "fusion.1"},
+        ]
+    }
+    import gzip
+
+    with gzip.open(trace_dir / "host.trace.json.gz", "wt") as f:
+        json.dump(device, f)
+    merged = merge_host_device(t, str(tmp_path))
+    assert merged["metadata"]["device_trace"] == "merged"
+    offset = merged["metadata"]["clock_offset_us"]
+    assert offset == pytest.approx(host_ts - 5000.0)
+    fusion = next(
+        e for e in merged["traceEvents"] if e.get("name") == "fusion.1"
+    )
+    assert fusion["ts"] == pytest.approx(5002.0 + offset)
+    # host spans untouched, on pid 1
+    host = next(
+        e for e in merged["traceEvents"]
+        if e.get("name") == "shared_phase" and e.get("pid") == 1
+    )
+    assert host["ts"] == pytest.approx(host_ts)
+
+
+def test_merge_without_device_trace_reports_absent(tmp_path):
+    from distributeddeeplearning_tpu.obs.profile import merge_host_device
+
+    t = Tracer(enabled=True, annotate=False)
+    with t.span("solo"):
+        pass
+    merged = merge_host_device(t, str(tmp_path))
+    assert merged["metadata"]["device_trace"] == "absent"
+    assert any(e.get("name") == "solo" for e in merged["traceEvents"])
+
+
+# --- histogram / summarize ------------------------------------------------
+
+@pytest.mark.parametrize(
+    "samples",
+    [
+        np.random.default_rng(0).lognormal(0.0, 1.0, 4000),
+        np.random.default_rng(1).uniform(0.001, 10.0, 4000),
+        np.full(100, 3.25),
+    ],
+    ids=["lognormal", "uniform", "constant"],
+)
+def test_histogram_percentiles_match_numpy(samples):
+    h = Histogram(max_rel_err=0.01)
+    h.record_many(samples)
+    for q in (50, 90, 99):
+        got = h.percentile(q)
+        want = float(np.percentile(samples, q))
+        # 1% sketch error + the interpolation-convention gap on finite n
+        assert got == pytest.approx(want, rel=0.03), (q, got, want)
+    assert h.max == pytest.approx(float(samples.max()))
+    assert h.mean == pytest.approx(float(samples.mean()), rel=1e-9)
+
+
+def test_histogram_percentiles_are_monotone_and_clamped():
+    h = Histogram()
+    h.record_many([0.0, 0.0, 1e-9, 5.0, 5.0, 5.0])
+    p50, p90, p99 = h.percentile(50), h.percentile(90), h.percentile(99)
+    assert p50 <= p90 <= p99 <= h.max
+    assert h.percentile(0) >= h.min
+
+
+def test_summarize_keys_and_empty():
+    s = summarize([1.0, 2.0, 3.0])
+    assert {"p50", "p90", "p99", "mean", "max"} <= set(s)
+    assert s["max"] == 3.0
+    empty = summarize([])
+    assert empty["p50"] == 0.0 and empty["max"] == 0.0
+
+
+def test_histogram_merge():
+    a, b = Histogram(), Histogram()
+    a.record_many([1.0, 2.0])
+    b.record_many([3.0, 4.0])
+    a.merge(b)
+    assert a.count == 4 and a.max == 4.0 and a.min == 1.0
+
+
+# --- registry + snapshots -------------------------------------------------
+
+def test_registry_counters_gauges_idempotent_names():
+    reg = MetricsRegistry()
+    reg.counter("x").inc()
+    reg.counter("x").inc(2)
+    reg.gauge("g").set(1.5)
+    reg.histogram("h").record(0.25)
+    snap = reg.snapshot(extra_field="yes")
+    assert snap["counters"]["x"] == 3
+    assert snap["gauges"]["g"] == 1.5
+    assert snap["histograms"]["h"]["count"] == 1
+    assert snap["extra_field"] == "yes"
+
+
+def test_snapshot_survives_injected_io_error_and_restart(
+    tmp_path, monkeypatch
+):
+    """The satellite contract: snapshot writes retry through injected
+    storage faults, and rows written before a 'restart' (a fresh registry
+    — process state lost) are still in the file after it."""
+    path = str(tmp_path / "obs.jsonl")
+    monkeypatch.setenv(faults.ENV_VAR, "io_error@1")
+    faults.reset()  # arm: the FIRST storage opportunity raises
+    try:
+        reg = MetricsRegistry()
+        reg.counter("runs").inc()
+        assert reg.write_snapshot(path, phase="before")  # retry absorbs it
+        assert reg.snapshots_written == 1
+        # restart: new registry (in-memory state gone), same file
+        reg2 = MetricsRegistry()
+        reg2.counter("runs").inc()
+        assert reg2.write_snapshot(path, phase="after")
+    finally:
+        monkeypatch.delenv(faults.ENV_VAR)
+        faults.reset()
+    rows = [json.loads(ln) for ln in open(path)]
+    assert [r["phase"] for r in rows] == ["before", "after"]
+    assert all(r["counters"]["runs"] == 1 for r in rows)
+
+
+def test_snapshot_exhausted_retries_drop_row_not_process(
+    tmp_path, monkeypatch
+):
+    path = str(tmp_path / "obs.jsonl")
+    monkeypatch.setenv(faults.ENV_VAR, "io_error@p=1.0")  # every attempt
+    faults.reset()
+    try:
+        reg = MetricsRegistry()
+        assert reg.write_snapshot(path) is False  # dropped, no raise
+        assert reg.snapshots_dropped == 1
+    finally:
+        monkeypatch.delenv(faults.ENV_VAR)
+        faults.reset()
+    assert not os.path.exists(path)
+
+
+# --- scheduler integration ------------------------------------------------
+
+class _FakeEngine:
+    """Duck-typed engine: instant prefill/decode, fixed token stream."""
+
+    batch_slots = 2
+    max_seq = 64
+    chunked_prefill = False
+    prefill_compiles = 0
+
+    def prefill(self, slot, prompt):
+        return 1
+
+    def decode(self, tokens, pos):
+        return np.full(self.batch_slots, 2, np.int32)
+
+
+def _run_fake_scheduler():
+    from distributeddeeplearning_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+
+    reqs = [Request(uid=f"r{i}", prompt=[1, 2, 3]) for i in range(4)]
+    return ContinuousBatchingScheduler(
+        _FakeEngine(), max_new_tokens=4
+    ).run(reqs)
+
+
+def test_scheduler_report_routes_through_obs_and_adds_tpot():
+    results, report = _run_fake_scheduler()
+    for block in (report.ttft_s, report.decode_step_s,
+                  report.queue_wait_s, report.tpot_s):
+        assert {"p50", "p90", "p99", "mean", "max"} <= set(block)
+    # every request generated 4 tokens: TPOT is measurable and finite
+    assert report.tpot_s["max"] >= 0
+    d = report.to_dict()
+    assert "tpot_s" in d
+
+
+def test_scheduler_emits_lifecycle_trace_events():
+    from distributeddeeplearning_tpu.obs import trace as trace_mod
+
+    tracer = trace_mod.set_tracer(Tracer(enabled=True, annotate=False))
+    try:
+        _run_fake_scheduler()
+        names = [e["name"] for e in tracer.events]
+    finally:
+        trace_mod.set_tracer(Tracer(enabled=False))
+    assert "serve/prefill" in names
+    assert "serve/decode_step" in names
+    assert names.count("serve/request_complete") == 4
+
+
+def test_scheduler_disabled_tracer_emits_nothing():
+    from distributeddeeplearning_tpu.obs import trace as trace_mod
+
+    tracer = trace_mod.set_tracer(Tracer(enabled=False))
+    try:
+        _run_fake_scheduler()
+        assert tracer.events == []
+    finally:
+        trace_mod.set_tracer(Tracer(enabled=False))
+
+
+# --- artifact schema ------------------------------------------------------
+
+def test_every_committed_revision_artifact_validates():
+    """Artifact drift (a dropped key, a malformed percentile block,
+    invalid JSON) fails tier-1 here — every committed ``*_r*.json``."""
+    from distributeddeeplearning_tpu.obs.schema import validate_artifact
+
+    paths = sorted(glob.glob(os.path.join(REPO_ROOT, "*_r*.json")))
+    assert paths, "no committed revision artifacts found"
+    for path in paths:
+        validate_artifact(path)
+
+
+def test_obs_schema_rejects_drift(tmp_path):
+    from distributeddeeplearning_tpu.obs.schema import (
+        SchemaError,
+        validate_artifact,
+    )
+
+    bad = tmp_path / "OBS_r99.json"
+    bad.write_text(json.dumps({"metric": "m", "value": 1, "unit": "x"}))
+    with pytest.raises(SchemaError, match="decode_breakdown"):
+        validate_artifact(str(bad))
+    notjson = tmp_path / "X_r99.json"
+    notjson.write_text("{nope")
+    with pytest.raises(SchemaError, match="not valid JSON"):
+        validate_artifact(str(notjson))
+    badp99 = tmp_path / "S_r99.json"
+    badp99.write_text(json.dumps(
+        {"ttft_s": {"p50": 2.0, "p99": 1.0}}
+    ))
+    with pytest.raises(SchemaError, match="p99 < p50"):
+        validate_artifact(str(badp99))
+
+
+# --- bench --obs CPU smoke ------------------------------------------------
+
+@pytest.mark.timeout(280)
+def test_bench_obs_steps_cap_smoke(tmp_path):
+    """End-to-end: ``bench.py --obs --small --steps-cap`` must emit a
+    schema-valid OBS artifact with a merged timeline and a per-engine
+    decode breakdown, on CPU, inside the fast tier's deadline."""
+    from distributeddeeplearning_tpu.obs.schema import validate_artifact
+
+    report = tmp_path / "OBS_smoke.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DDLT_FAULTS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+            "--obs", "--small", "--steps-cap", "2",
+            "--serve-requests", "3", "--max-new-tokens", "3",
+            "--report", str(report),
+            "--trace-dir", str(tmp_path / "trace"),
+        ],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=260,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    line = validate_artifact(str(report))
+    assert line["bench_revision"] >= 11
+    assert set(line["decode_breakdown"]) == {"f32", "kv_int8"}
+    assert line["decode_breakdown"]["kv_int8"]["kv_dtype"] == "int8"
+    # the attribution names a real phase of the int8 engine
+    hottest = line["regression_attribution"]["hottest_phase"]
+    assert hottest in line["decode_breakdown"]["kv_int8"]["phases_ms"]
+    # merged timeline digest carries both halves
+    counts = line["timeline"]["event_counts"]
+    assert counts["host_spans"] > 0
+    # full merged chrome trace landed next to the device trace
+    assert os.path.exists(tmp_path / "trace" / "merged.trace.json")

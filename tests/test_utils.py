@@ -35,6 +35,27 @@ def test_timer_decorator_and_report():
     assert len(messages) == 1 and messages[0].startswith("work:")
 
 
+def test_timer_feeds_obs_histogram():
+    """The Timer->obs bridge: every stop() (including via the decorator,
+    which must propagate the histogram) records elapsed seconds into the
+    given streaming histogram."""
+    from distributeddeeplearning_tpu.obs import Histogram
+
+    h = Histogram("timed_phase")
+    with Timer(histogram=h):
+        time.sleep(0.002)
+
+    @Timer(histogram=h)
+    def work():
+        time.sleep(0.002)
+
+    work()
+    work()
+    assert h.count == 3
+    assert 0.001 < h.min and h.max < 1.0
+    assert h.summary()["p50"] > 0.0
+
+
 def test_average_meter():
     m = AverageMeter("loss")
     m.update(2.0, n=2)
