@@ -42,7 +42,7 @@ V100_TF_CNN_BENCHMARKS_IMG_SEC = 720.0
 #: ``_rNN`` suffix (the drift that left COMMS at r09 while RESILIENCE sat
 #: at r07).  Committed artifacts keep their historical names; NEW runs
 #: write ``<KIND>_r{BENCH_REVISION}.json``.
-BENCH_REVISION = 11
+BENCH_REVISION = 12
 
 
 def artifact_name(kind: str) -> str:
@@ -1561,6 +1561,232 @@ def _run_faults(args) -> int:
     return 0 if line["completed_exact"] and faulted.returncode == 0 else 1
 
 
+def _run_serve_faults(args) -> int:
+    """Serving chaos benchmark: the supervised replica fleet
+    (``serve/fleet.py``) driven through an injected serve-side fault
+    schedule, measured against the identical fault-free fleet.
+
+    The ``SERVE_RESILIENCE_*.json`` artifact answers the question the
+    serving resilience layer exists for: what does surviving replica
+    death, decode NaNs, stalls and shedding COST, and does the traffic
+    notice?  Gates (return code 1 on violation):
+
+    - **zero lost requests**: every request touched by ``replica_death``
+      is requeued and completes (``lost_requests == 0``);
+    - **bit-identical failover**: every request that completes OK in the
+      faulted run carries EXACTLY the fault-free run's greedy tokens —
+      failover continuation (prompt + streamed prefix) is not allowed to
+      change the output;
+    - **quarantine precision**: only the ``decode_nan``-poisoned
+      request(s) fail — exactly as many errors as ``decode_nan`` entries
+      in the spec;
+    - **bounded recovery overhead**: faulted wall vs clean wall under
+      ``--serve-overhead-limit`` % (spawn/compile of the restarted
+      replica overlaps surviving replicas' decode, so the fleet pays far
+      less than one replica's cold start).
+
+    Both runs use the same spec, seeds and traffic, so the delta is
+    *recovery*, not workload.
+    """
+    import jax
+    import numpy as np
+
+    from distributeddeeplearning_tpu.obs import trace as trace_mod
+    from distributeddeeplearning_tpu.serve import (
+        ReplicaSpec,
+        serve_fleet,
+        synthetic_requests,
+    )
+    from distributeddeeplearning_tpu.utils import faults as faults_mod
+
+    dims = dict(num_layers=12, d_model=768, num_heads=12, d_ff=3072,
+                vocab_size=32768)
+    if args.small:
+        dims = dict(num_layers=2, d_model=64, num_heads=4, d_ff=128,
+                    vocab_size=257)
+    max_prompt = max(8, args.seq_len)
+    new_tokens = args.serve_faults_new_tokens
+    max_seq = max_prompt + new_tokens
+    spec = ReplicaSpec(
+        model=dict(max_len=max_seq, **dims),
+        seed=0,
+        num_heads=dims["num_heads"],
+        batch_slots=args.batch_slots,
+        max_seq=max_seq,
+        kv_layout="paged",
+        page_size=args.page_size,
+        num_pages=args.kv_pages,
+        prefill_chunk=args.prefill_chunk,
+        temperature=0.0,  # greedy: the bit-identical gate needs it
+        max_new_tokens=new_tokens,
+    )
+    requests = synthetic_requests(
+        args.serve_faults_requests, vocab_size=dims["vocab_size"],
+        max_prompt=max_prompt, min_prompt=max(2, max_prompt // 8),
+        rng=np.random.default_rng(0),
+    )
+    n_nan = sum(
+        1 for s in faults_mod.parse_spec(args.serve_faults_spec)
+        if s.kind == "decode_nan"
+    )
+
+    def run_fleet(faults_text):
+        return serve_fleet(
+            spec, requests,
+            replicas=args.serve_replicas,
+            max_restarts=args.serve_max_restarts,
+            faults=faults_text,
+        )
+
+    # Warmup fleet (discarded): the FIRST fleet of the process pays
+    # one-time costs its successor never sees again — OS page-cache
+    # warming of the jax wheels every spawned worker re-imports, and the
+    # persistent-compilation-cache population the workers share.  Without
+    # this the clean run (always first) is systematically slower and the
+    # overhead reads negative.
+    warm = requests[: min(4, len(requests))]
+    print(
+        f"[serve-faults] warmup fleet ({len(warm)} requests, discarded)",
+        file=sys.stderr,
+    )
+    serve_fleet(
+        spec, warm, replicas=args.serve_replicas, faults="",
+    )
+    print(
+        f"[serve-faults] clean fleet: {args.serve_replicas} replicas, "
+        f"{args.serve_faults_requests} requests", file=sys.stderr,
+    )
+    clean_res, clean_rep = run_fleet("")
+    if clean_rep.completed_ok != len(requests):
+        print(
+            f"[serve-faults] clean fleet run degraded "
+            f"({clean_rep.finish_reasons}) — no baseline to compare",
+            file=sys.stderr,
+        )
+        return 1
+    # router-side fleet events land on the obs timeline; record the
+    # faulted run's so the artifact carries the recovery story
+    tracer = trace_mod.set_tracer(
+        trace_mod.Tracer(enabled=True, annotate=False)
+    )
+    try:
+        print(
+            f"[serve-faults] chaos fleet: {args.serve_faults_spec}",
+            file=sys.stderr,
+        )
+        fault_res, fault_rep = run_fleet(args.serve_faults_spec)
+    finally:
+        trace_mod.set_tracer(trace_mod.Tracer(enabled=False))
+    fleet_events: dict = {}
+    for ev in tracer.events:
+        name = ev.get("name", "")
+        if name.startswith("fleet/"):
+            fleet_events[name] = fleet_events.get(name, 0) + 1
+
+    # Overhead is a WALL-TIME ratio, and wall time on a shared/throttled
+    # host swings far more than the recovery cost being measured (the
+    # same clean fleet has been observed at 20 s and 33 s minutes apart).
+    # Per side, take the MIN wall over `--serve-faults-trials` runs:
+    # contention only ever ADDS time, so the min is the least-noisy
+    # estimate of each side's true cost.  Correctness gates (tokens,
+    # finish reasons, losses) come from the FIRST pair — greedy decode
+    # makes repeats token-identical anyway.
+    clean_walls = [clean_rep.wall_s]
+    fault_walls = [fault_rep.wall_s]
+    for trial in range(1, args.serve_faults_trials):
+        print(
+            f"[serve-faults] wall trial {trial + 1}/"
+            f"{args.serve_faults_trials}", file=sys.stderr,
+        )
+        # the first pair ran clean-then-faulted; alternate the order on
+        # extra trials so a slowly-relaxing host throttle cannot keep
+        # handing the same side the better phase
+        order = (
+            ("", args.serve_faults_spec)
+            if trial % 2 == 0
+            else (args.serve_faults_spec, "")
+        )
+        for spec_text in order:
+            _, rep = run_fleet(spec_text)
+            (clean_walls if spec_text == "" else fault_walls).append(
+                rep.wall_s
+            )
+    clean_wall = min(clean_walls)
+    fault_wall = min(fault_walls)
+
+    clean_tokens = {r.uid: list(r.tokens) for r in clean_res}
+    mismatched = [
+        r.uid
+        for r in fault_res
+        if r.finish_reason in ("eos", "length")
+        and list(r.tokens) != clean_tokens[r.uid]
+    ]
+    poisoned = [
+        r.uid for r in fault_res
+        if r.finish_reason == "error"
+        and "non-finite" in (r.error or "")
+    ]
+    overhead_pct = round(
+        100.0 * (fault_wall - clean_wall) / clean_wall, 2
+    )
+    gates = {
+        "zero_lost_requests": fault_rep.lost_requests == 0,
+        "tokens_bit_identical": not mismatched,
+        "only_poisoned_failed": (
+            fault_rep.errors == len(poisoned) == n_nan
+        ),
+        "recovery_overhead_under_limit": (
+            overhead_pct < args.serve_overhead_limit
+        ),
+    }
+    line = {
+        "metric": "serve_fleet_chaos_recovery_overhead_pct",
+        "value": overhead_pct,
+        "unit": "%",
+        "vs_baseline": None,
+        "bench_revision": BENCH_REVISION,
+        "faults_spec": args.serve_faults_spec,
+        "replicas": args.serve_replicas,
+        "max_restarts": args.serve_max_restarts,
+        "requests": args.serve_faults_requests,
+        "max_new_tokens": new_tokens,
+        "max_prompt": max_prompt,
+        "model_dims": dims,
+        "recovery_overhead_pct": overhead_pct,
+        "overhead_limit_pct": args.serve_overhead_limit,
+        "wall_trials": args.serve_faults_trials,
+        "clean_wall_s": round(clean_wall, 4),
+        "faulted_wall_s": round(fault_wall, 4),
+        "clean_walls_s": [round(w, 4) for w in clean_walls],
+        "faulted_walls_s": [round(w, 4) for w in fault_walls],
+        "tokens_bit_identical": not mismatched,
+        "mismatched_uids": mismatched,
+        "poisoned_failed_uids": poisoned,
+        "expected_poisoned": n_nan,
+        "fleet_events": fleet_events,
+        "gates": gates,
+        "clean": clean_rep.to_dict(),
+        "faulted": fault_rep.to_dict(),
+        "platform": jax.default_backend(),
+        "virtual_pod": _is_virtual_pod(),
+    }
+    print(json.dumps({
+        k: line[k] for k in (
+            "metric", "value", "unit", "vs_baseline", "faults_spec",
+            "gates",
+        )
+    }))
+    report_path = args.report or artifact_name("SERVE_RESILIENCE")
+    with open(report_path, "w") as f:
+        json.dump(line, f, indent=2)
+        f.write("\n")
+    print(f"[serve-faults] report -> {report_path}", file=sys.stderr)
+    if not all(gates.values()):
+        print(f"[serve-faults] GATES FAILED: {gates}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _run_comms(args) -> int:
     """Gradient-communication benchmark: the explicit comm_overlap schedule
     (``parallel/comms.py`` — bucketed reduce-scatter in the accumulation
@@ -2127,6 +2353,72 @@ def main() -> int:
         help="supervisor restart budget for --faults",
     )
     parser.add_argument(
+        "--serve-faults",
+        action="store_true",
+        help="serving chaos benchmark: the supervised replica fleet "
+        "(serve/fleet.py) under an injected serve-side fault schedule vs "
+        "the identical fault-free fleet; emits SERVE_RESILIENCE_r{NN}."
+        "json and gates on zero lost requests, bit-identical greedy "
+        "failover, quarantine precision and recovery overhead",
+    )
+    parser.add_argument(
+        "--serve-faults-spec",
+        default="replica_death@3,decode_nan@5,decode_stall@8:secs=0.2",
+        help="DDLT_FAULTS schedule for --serve-faults (serve-side kinds "
+        "are dealt one-per-replica; README 'Serving fault tolerance' has "
+        "the grammar)",
+    )
+    parser.add_argument(
+        "--serve-replicas",
+        type=int,
+        default=2,
+        help="fleet width for --serve-faults (>= 2 so replica_death "
+        "leaves a survivor to fail over to)",
+    )
+    parser.add_argument(
+        "--serve-max-restarts",
+        type=int,
+        default=1,
+        help="per-replica restart budget for --serve-faults",
+    )
+    parser.add_argument(
+        "--serve-faults-requests",
+        type=int,
+        default=192,
+        help="request count for --serve-faults (independent of --serve-"
+        "requests: the chaos run needs enough work that the fixed "
+        "restart cost amortizes — the recovery-overhead gate measures "
+        "steady-state resilience, not cold-start arithmetic; at the "
+        "default the restarted replica rejoins MID-RUN and shares the "
+        "remaining load, which is the recovery story worth measuring)",
+    )
+    parser.add_argument(
+        "--serve-faults-trials",
+        type=int,
+        default=2,
+        help="wall-time trials per side for --serve-faults; the overhead "
+        "gate compares per-side MIN walls (host contention only adds "
+        "time, so the min is the noise-robust estimate; correctness "
+        "gates always use the first pair)",
+    )
+    parser.add_argument(
+        "--serve-faults-new-tokens",
+        type=int,
+        default=48,
+        help="per-request generation budget for --serve-faults (its own "
+        "knob, not --max-new-tokens: the run must outlast the restarted "
+        "replica's respawn or the overhead gate measures a fleet that "
+        "never got its capacity back)",
+    )
+    parser.add_argument(
+        "--serve-overhead-limit",
+        type=float,
+        default=30.0,
+        help="recovery-overhead gate for --serve-faults (percent of the "
+        "fault-free wall; CI smokes with tiny workloads raise it — a "
+        "fixed restart cost dominates a short run)",
+    )
+    parser.add_argument(
         "--report",
         default=None,
         help="artifact output path for --faults/--quant/--comms/--obs "
@@ -2178,6 +2470,18 @@ def main() -> int:
         parser.error("--serve and --devices are mutually exclusive")
     if args.faults and (args.serve or args.devices or args.data):
         parser.error("--faults is exclusive with --serve/--devices/--data")
+    if args.serve_faults and (args.serve or args.devices or args.data
+                              or args.faults or args.comms or args.quant
+                              or args.obs):
+        parser.error(
+            "--serve-faults is exclusive with --serve/--devices/--data/"
+            "--faults/--comms/--quant/--obs"
+        )
+    if args.serve_faults and args.serve_replicas < 2:
+        parser.error(
+            "--serve-faults needs --serve-replicas >= 2 (replica_death "
+            "must leave a survivor to fail over to)"
+        )
     if args.comms:
         if args.serve or args.devices or args.data or args.faults:
             parser.error(
@@ -2251,6 +2555,8 @@ def main() -> int:
     enable_compilation_cache()
     if args.faults:
         return _run_faults(args)
+    if args.serve_faults:
+        return _run_serve_faults(args)
     if args.quant:
         return _run_quant(args)
     if args.obs:

@@ -406,6 +406,37 @@ def build_parser() -> argparse.ArgumentParser:
                          "the f32 and quantized model before serving "
                          "(--quantize-weights): prints logit MAE + greedy "
                          "agreement to stderr; 0 = quantize blind")
+    serve_p.add_argument("--replicas", type=int, default=1,
+                         help="engine replica WORKER PROCESSES (serve/"
+                         "fleet.py): >1 runs the supervised fleet — a "
+                         "router load-balances requests, health-checks "
+                         "replicas by heartbeat, restarts dead ones and "
+                         "fails in-flight requests over to survivors "
+                         "(greedy output stays bit-identical)")
+    serve_p.add_argument("--max-restarts", type=int, default=1,
+                         help="restarts each dead replica gets before it "
+                         "stays down (--replicas > 1)")
+    serve_p.add_argument("--max-redeliveries", type=int, default=2,
+                         help="failover retries per request before it "
+                         "finishes 'error' (at-most-K redelivery)")
+    serve_p.add_argument("--request-deadline-s", type=float, default=None,
+                         help="per-request deadline: past it a request "
+                         "finishes 'deadline' (queued: unstarted; "
+                         "decoding: with its partial tokens)")
+    serve_p.add_argument("--watchdog-deadline-s", type=float, default=None,
+                         help="scheduler-loop watchdog (train/resilience."
+                         "StepWatchdog): no loop progress for this long "
+                         "dumps stacks and exits 70 so a supervisor "
+                         "restarts the worker")
+    serve_p.add_argument("--heartbeat-timeout-s", type=float, default=None,
+                         help="router-side staleness bound on replica "
+                         "heartbeats (--replicas > 1): a silent replica "
+                         "with work in flight is killed and its requests "
+                         "failed over.  Size it ABOVE the worst-case jit "
+                         "compile (a blocking compile gaps the heartbeat "
+                         "stream); for finer hang detection use "
+                         "--watchdog-deadline-s, which runs inside the "
+                         "worker and excludes first-step compiles")
     serve_p.add_argument("--report", default=None,
                          help="also write the stats JSON here "
                          "(e.g. SERVE_r06.json)")
@@ -1202,7 +1233,13 @@ def _cmd_serve(args) -> int:
             f"position table {params['pos'].shape[0]}", file=sys.stderr,
         )
         max_seq = params["pos"].shape[0]
-    if params is None:
+    if params is None and args.replicas <= 1:
+        # fleet workers build their own params from the spec — the
+        # router process materializing a model it never serves would
+        # cost a full extra init + resident copy for the fleet's life.
+        # (Prompt validation below needs only vocab/max_seq, both known
+        # here; a restored checkpoint is still loaded above for its
+        # true head vocab and position-table clamp.)
         params = init_params(
             jax.random.key(args.seed),
             num_layers=args.num_layers, d_model=args.d_model,
@@ -1238,6 +1275,90 @@ def _cmd_serve(args) -> int:
             file=sys.stderr,
         )
         return 1
+
+    if args.replicas > 1:
+        # Fleet path: N replica worker processes behind the supervising
+        # router (serve/fleet.py).  Workers build their own engines from
+        # the spec — params never cross the process boundary — so the
+        # engine build below is skipped entirely.  SIGTERM drains the
+        # fleet and the process exits 75 (RESUMABLE_EXIT_CODE): the
+        # control plane's resubmit path treats a drained server exactly
+        # like a preempted training run.
+        from distributeddeeplearning_tpu.serve.fleet import (
+            ReplicaSpec,
+            serve_fleet,
+        )
+        from distributeddeeplearning_tpu.train.resilience import (
+            RESUMABLE_EXIT_CODE,
+        )
+        from distributeddeeplearning_tpu.utils.virtual_pod import (
+            is_virtual_pod,
+        )
+
+        if args.trace_dir:
+            print("[serve] --trace-dir is per-process; fleet runs emit "
+                  "obs events but no merged device trace", file=sys.stderr)
+        if args.quantize_weights and args.calib_prompts:
+            print("[serve] fleet workers quantize weights without "
+                  "calibration (--calib-prompts is single-replica only)",
+                  file=sys.stderr)
+        spec = ReplicaSpec(
+            model=(
+                {} if args.checkpoint_dir else dict(
+                    num_layers=args.num_layers, d_model=args.d_model,
+                    num_heads=num_heads, d_ff=args.d_ff,
+                    vocab_size=vocab, max_len=max_seq,
+                )
+            ),
+            seed=args.seed,
+            checkpoint_dir=args.checkpoint_dir,
+            quantize_weights=args.quantize_weights,
+            num_heads=num_heads,
+            batch_slots=args.batch_slots,
+            max_seq=max_seq,
+            kv_layout=args.kv_layout,
+            page_size=args.page_size,
+            num_pages=args.kv_pages,
+            prefill_chunk=args.prefill_chunk,
+            prefix_cache=not args.no_prefix_cache,
+            prefill_attention=args.prefill_attention,
+            cache_dtype=args.quantize_kv,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            eos_id=args.eos_id,
+            max_new_tokens=args.max_new_tokens,
+            request_deadline_s=args.request_deadline_s,
+            watchdog_deadline_s=args.watchdog_deadline_s,
+        )
+        # validation (vocab / position-table clamp) is done with the
+        # restored pytree; the workers restore their own copies, so
+        # holding it through the fleet's whole life would be the exact
+        # resident extra model the fleet path exists to avoid
+        params = None
+        results, freport = serve_fleet(
+            spec,
+            [Request(uid=uid, prompt=p) for uid, p in prompts],
+            replicas=args.replicas,
+            max_restarts=args.max_restarts,
+            max_redeliveries=args.max_redeliveries,
+            heartbeat_timeout_s=args.heartbeat_timeout_s,
+            install_signals=True,
+        )
+        stats = freport.to_dict()
+        stats["platform"] = jax.default_backend()
+        stats["virtual_pod"] = is_virtual_pod()
+        if args.synthetic:
+            print(_json.dumps(stats))
+        else:
+            for r in results:
+                print(f"{r.uid}\t{' '.join(str(t) for t in r.tokens)}")
+            print(_json.dumps(stats), file=sys.stderr)
+        if args.report:
+            with open(args.report, "w") as f:
+                _json.dump(stats, f, indent=2)
+                f.write("\n")
+            print(f"[serve] report -> {args.report}", file=sys.stderr)
+        return RESUMABLE_EXIT_CODE if freport.drained else 0
 
     # Weight PTQ after validation (the checks above need the f32 head's
     # true vocab) and before engine build: with --calib-prompts the
@@ -1316,28 +1437,49 @@ def _cmd_serve(args) -> int:
             rng=jax.random.key(args.seed),
         )
     scheduler = ContinuousBatchingScheduler(
-        engine, eos_id=args.eos_id, max_new_tokens=args.max_new_tokens
+        engine, eos_id=args.eos_id, max_new_tokens=args.max_new_tokens,
+        request_deadline_s=args.request_deadline_s,
+        watchdog_deadline_s=args.watchdog_deadline_s,
     )
     reqs = [Request(uid=uid, prompt=p) for uid, p in prompts]
-    if args.trace_dir:
-        # obs mode: host spans (request lifecycle, prefill chunks, decode
-        # dispatch) + the jax.profiler device trace, merged onto one
-        # Chrome-trace timeline under --trace-dir
-        from distributeddeeplearning_tpu.obs import configure
-        from distributeddeeplearning_tpu.obs.profile import profile_and_merge
+    # SIGTERM -> graceful drain (stop admitting, finish active requests,
+    # queued ones return "preempted") -> exit 75, the same resumable-exit
+    # contract the training loop uses, so the control plane resubmits a
+    # drained server like a preempted run
+    import signal as _signal
 
-        tracer = configure(enabled=False)  # enabled inside the window
+    from distributeddeeplearning_tpu.train.resilience import (
+        RESUMABLE_EXIT_CODE,
+        PreemptionGuard,
+    )
 
-        def _serve_run():
-            with tracer.span("serve/run", requests=len(reqs)):
-                return scheduler.run(reqs)
+    guard = PreemptionGuard(signals=(_signal.SIGTERM,)).install()
+    try:
+        if args.trace_dir:
+            # obs mode: host spans (request lifecycle, prefill chunks,
+            # decode dispatch) + the jax.profiler device trace, merged
+            # onto one Chrome-trace timeline under --trace-dir
+            from distributeddeeplearning_tpu.obs import configure
+            from distributeddeeplearning_tpu.obs.profile import (
+                profile_and_merge,
+            )
 
-        (results, report), _, _, merged_path = profile_and_merge(
-            _serve_run, trace_dir=args.trace_dir, tracer=tracer
-        )
-        print(f"[serve] merged trace -> {merged_path}", file=sys.stderr)
-    else:
-        results, report = scheduler.run(reqs)
+            tracer = configure(enabled=False)  # enabled inside the window
+
+            def _serve_run():
+                with tracer.span("serve/run", requests=len(reqs)):
+                    return scheduler.run(reqs, should_drain=guard.preempted)
+
+            (results, report), _, _, merged_path = profile_and_merge(
+                _serve_run, trace_dir=args.trace_dir, tracer=tracer
+            )
+            print(f"[serve] merged trace -> {merged_path}", file=sys.stderr)
+        else:
+            results, report = scheduler.run(
+                reqs, should_drain=guard.preempted
+            )
+    finally:
+        guard.uninstall()
 
     from distributeddeeplearning_tpu.utils.virtual_pod import is_virtual_pod
 
@@ -1358,7 +1500,7 @@ def _cmd_serve(args) -> int:
             _json.dump(stats, f, indent=2)
             f.write("\n")
         print(f"[serve] report -> {args.report}", file=sys.stderr)
-    return 0
+    return RESUMABLE_EXIT_CODE if report.drained else 0
 
 
 def _cmd_obs(args) -> int:

@@ -15,7 +15,10 @@ committed revision artifact:
 - ``OBS_*`` artifacts additionally validate against the full obs schema
   (merged timeline digest + decode phase breakdown + regression
   attribution), since the whole point of OBS_r11 is that downstream
-  work (ROADMAP Open item 2) can script against it.
+  work (ROADMAP Open item 2) can script against it;
+- ``SERVE_RESILIENCE_*`` artifacts validate against the serving chaos
+  schema (clean/faulted FleetReport pair, gate booleans, fleet timeline
+  event digest) — the evidence the fleet's failover story rests on.
 """
 
 from __future__ import annotations
@@ -23,7 +26,12 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List
 
-__all__ = ["SchemaError", "validate_artifact", "validate_obs_payload"]
+__all__ = [
+    "SchemaError",
+    "validate_artifact",
+    "validate_obs_payload",
+    "validate_serve_resilience_payload",
+]
 
 #: latency blocks whose percentile keys are a cross-artifact contract
 PERCENTILE_BLOCKS = ("ttft_s", "decode_step_s", "queue_wait_s", "tpot_s")
@@ -135,6 +143,81 @@ def validate_obs_payload(payload: Dict[str, Any]) -> None:
         raise SchemaError("; ".join(errors))
 
 
+def validate_serve_resilience_payload(payload: Dict[str, Any]) -> None:
+    """Strict schema for the ``SERVE_RESILIENCE_r{NN}.json`` artifact body.
+
+    The chaos bench's evidence trail: a fleet-with-faults run compared
+    against the fault-free baseline.  Downstream consumers (README
+    tables, regression scripts) index the gate booleans and the
+    clean/faulted report pair, so their shape is a contract.
+    """
+    errors: List[str] = []
+
+    def require(cond: bool, msg: str) -> None:
+        if not cond:
+            errors.append(msg)
+
+    for key in ("metric", "value", "unit", "bench_revision", "platform",
+                "virtual_pod", "faults_spec", "replicas",
+                "recovery_overhead_pct", "tokens_bit_identical",
+                "fleet_events", "gates", "clean", "faulted"):
+        require(key in payload, f"missing top-level key {key!r}")
+
+    require(
+        isinstance(payload.get("recovery_overhead_pct"), (int, float)),
+        "recovery_overhead_pct must be numeric",
+    )
+    require(
+        isinstance(payload.get("tokens_bit_identical"), bool),
+        "tokens_bit_identical must be a bool",
+    )
+    gates = payload.get("gates")
+    if isinstance(gates, dict):
+        for gk in ("zero_lost_requests", "tokens_bit_identical",
+                   "only_poisoned_failed",
+                   "recovery_overhead_under_limit"):
+            require(
+                isinstance(gates.get(gk), bool),
+                f"gates.{gk} must be a bool",
+            )
+    else:
+        require(False, "gates must be a dict")
+    for side in ("clean", "faulted"):
+        rep = payload.get(side)
+        if not isinstance(rep, dict):
+            require(False, f"{side} must be a FleetReport dict")
+            continue
+        for key in ("replicas", "requests", "wall_s",
+                    "goodput_tokens_per_sec", "finish_reasons",
+                    "ttft_s", "tpot_s", "restarts", "replica_deaths",
+                    "redeliveries", "lost_requests", "drained"):
+            require(key in rep, f"{side} missing key {key!r}")
+        require(
+            isinstance(rep.get("finish_reasons"), dict),
+            f"{side}.finish_reasons must be a dict",
+        )
+        for key in ("lost_requests", "redeliveries", "restarts",
+                    "replica_deaths"):
+            require(
+                isinstance(rep.get(key), int),
+                f"{side}.{key} must be an int",
+            )
+    faulted = payload.get("faulted")
+    if isinstance(faulted, dict):
+        require(
+            isinstance(payload.get("fleet_events"), dict)
+            and (
+                faulted.get("replica_deaths", 0) == 0
+                or "fleet/replica_died" in payload["fleet_events"]
+            ),
+            "a faulted run with replica deaths must carry the "
+            "fleet/replica_died timeline event",
+        )
+
+    if errors:
+        raise SchemaError("; ".join(errors))
+
+
 def validate_artifact(path: str) -> Any:
     """Validate one committed artifact file; returns the parsed JSON.
 
@@ -159,9 +242,15 @@ def validate_artifact(path: str) -> Any:
 
     import os
 
-    if os.path.basename(path).startswith("OBS_") and isinstance(data, dict):
+    base = os.path.basename(path)
+    if base.startswith("OBS_") and isinstance(data, dict):
         try:
             validate_obs_payload(data)
+        except SchemaError as exc:
+            errors.append(str(exc))
+    if base.startswith("SERVE_RESILIENCE_") and isinstance(data, dict):
+        try:
+            validate_serve_resilience_payload(data)
         except SchemaError as exc:
             errors.append(str(exc))
 

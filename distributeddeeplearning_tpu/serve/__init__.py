@@ -32,6 +32,12 @@ from distributeddeeplearning_tpu.serve.engine import (
     data_parallel_engine,
     sample_logits,
 )
+from distributeddeeplearning_tpu.serve.fleet import (
+    FleetReport,
+    FleetRouter,
+    ReplicaSpec,
+    serve_fleet,
+)
 from distributeddeeplearning_tpu.serve.kv_cache import (
     OutOfPages,
     PageAllocator,
@@ -55,6 +61,10 @@ from distributeddeeplearning_tpu.serve.scheduler import (
 __all__ = [
     "InferenceEngine",
     "PagedInferenceEngine",
+    "ReplicaSpec",
+    "FleetRouter",
+    "FleetReport",
+    "serve_fleet",
     "PrefillTask",
     "data_parallel_engine",
     "sample_logits",

@@ -213,6 +213,10 @@ class InferenceEngine:
         self.weights_dtype = params_dtype(params)
         self._base_rng = jax.random.key(0) if rng is None else rng
         self._sample_step = 0
+        # per-slot logit-finiteness verdict of the LAST decode step,
+        # computed in-jit alongside sampling (the scheduler's NaN
+        # quarantine reads it from the same readback — no extra sync)
+        self.last_finite: Optional[np.ndarray] = None
 
         self._cache = init_cache(
             batch_slots=batch_slots,
@@ -243,7 +247,7 @@ class InferenceEngine:
             self.params = jax.device_put(params, p_shard)
             self._cache = jax.device_put(self._cache, c_shard)
             decode_in = (p_shard, c_shard, slot_vec, slot_vec, rep)
-            decode_out = (rep, c_shard)
+            decode_out = (rep, rep, c_shard)  # tokens, finite, cache
             insert_in = (c_shard, rep, rep, rep)
             jit_kw = dict(in_shardings=decode_in, out_shardings=decode_out)
             insert_kw = dict(in_shardings=insert_in, out_shardings=c_shard)
@@ -279,7 +283,10 @@ class InferenceEngine:
             logits, cache = forward_decode(
                 params, tokens, cache, pos, num_heads=num_heads
             )
-            return _sample(logits, step), cache
+            # per-slot health verdict rides the step (one [slots] bool —
+            # the NaN-quarantine signal, free next to the token readback)
+            finite = jnp.isfinite(logits).all(axis=-1)
+            return _sample(logits, step), finite, cache
 
         # one compiled prefill per prompt bucket (jit cache keyed on P)
         self._prefill_jit = jax.jit(_prefill_fn)
@@ -369,14 +376,44 @@ class InferenceEngine:
         # the decode step (the readback is the scheduler's one designed
         # sync — it needs the token ids)
         with get_tracer().span("serve/engine.decode_dispatch"):
-            toks, self._cache = self._decode_jit(
+            toks, finite, self._cache = self._decode_jit(
                 self.params,
                 self._cache,
                 jnp.asarray(tokens, jnp.int32),
                 jnp.asarray(pos, jnp.int32),
                 jnp.int32(self._next_step()),
             )
+        # the finite readback piggybacks on the token sync the scheduler
+        # already pays (same computation, already materialized)
+        self.last_finite = np.asarray(finite)
         return np.asarray(toks)
+
+    # -- fault injection / quarantine hooks --------------------------------
+    def poison_slot(self, slot: int, pos: int) -> None:
+        """Corrupt ``slot``'s K history at ``pos`` with NaN (the
+        ``decode_nan`` fault's entry point — deterministic chaos only).
+
+        K ONLY, never V: a NaN key makes the poisoned slot's own scores
+        NaN (the quarantine signal) while positions masked for a FUTURE
+        occupant are replaced by the -1e30 fill *before* softmax, so the
+        NaN never escapes the victim.  A NaN *value* would leak through
+        masking — softmax gives masked lanes exactly-0.0 weights and
+        ``0.0 * NaN == NaN``."""
+        c = dict(self._cache)
+        if "k_scale" in c:  # int8 K can't hold NaN — poison the f32 scales
+            c["k_scale"] = c["k_scale"].at[slot, :, pos].set(jnp.nan)
+        else:
+            c["k"] = c["k"].at[slot, :, pos].set(jnp.nan)
+        self._cache = c
+
+    def scrub_slot(self, slot: int, from_pos: int = 0) -> None:
+        """Zero the slot's cache row (quarantine cleanup): dense rows are
+        fully private, so the whole row goes — no NaN survives for the
+        slot's next occupant."""
+        c = dict(self._cache)
+        for key in c:
+            c[key] = c[key].at[slot].set(0)
+        self._cache = c
 
 
 class PrefillTask:
@@ -475,6 +512,9 @@ class PagedInferenceEngine:
         self.capture_logits = capture_logits
         self.last_logits: Optional[np.ndarray] = None
         self.last_prefill_logits: Optional[np.ndarray] = None
+        # per-slot logit-finiteness verdict of the LAST decode step (the
+        # scheduler's NaN-quarantine signal; same readback as the tokens)
+        self.last_finite: Optional[np.ndarray] = None
         self._base_rng = jax.random.key(0) if rng is None else rng
         self._sample_step = 0
 
@@ -535,13 +575,15 @@ class PagedInferenceEngine:
                 params, tokens, cache, pos, block_tables,
                 num_heads=num_heads, page_size=page_size,
             )
+            # per-slot health verdict (NaN quarantine) — one [slots] bool
+            finite = jnp.isfinite(logits).all(axis=-1)
             # ``with_logits`` is static: the production program (False)
             # never materializes a [B, vocab] output it would discard —
             # logits stay a fusable intermediate of the sampler; the
             # probe variant (True) compiles separately on first use
             if with_logits:
-                return _sample(logits, step), logits, cache
-            return _sample(logits, step), cache
+                return _sample(logits, step), logits, finite, cache
+            return _sample(logits, step), finite, cache
 
         # one compiled chunk program per chunk shape (<= log2(chunk) of
         # them: full chunks plus power-of-two final-chunk buckets)
@@ -794,16 +836,57 @@ class PagedInferenceEngine:
         logits = None
         with get_tracer().span("serve/engine.decode_dispatch"):
             if self.capture_logits:
-                toks, logits, self._cache = self._decode_jit(*args, True)
+                toks, logits, finite, self._cache = self._decode_jit(
+                    *args, True
+                )
             else:
-                toks, self._cache = self._decode_jit(*args, False)
+                toks, finite, self._cache = self._decode_jit(*args, False)
         # probe readback OUTSIDE the dispatch span (same contract as the
         # dense engine): the logits device->host sync must not be billed
         # to dispatch, or the dispatch-vs-readback gap on the merged
         # timeline reads as ~0 exactly when capture_logits is on
         if logits is not None:
             self.last_logits = np.asarray(logits)
+        self.last_finite = np.asarray(finite)
         return np.asarray(toks)
+
+    # -- fault injection / quarantine hooks --------------------------------
+    def poison_slot(self, slot: int, pos: int) -> None:
+        """Corrupt ``slot``'s K history at logical position ``pos`` with
+        NaN (the ``decode_nan`` fault).  K only — see the dense engine's
+        docstring for why a NaN *value* would leak through masking.
+
+        The caller must pass a DECODE-WRITTEN position (>= the delivery's
+        prompt length): pages covering those positions are never in the
+        prefix table (only full *prompt* pages register), so the poison
+        can only ever land in a page private to this slot."""
+        pages = self._slot_pages.get(slot)
+        if not pages:
+            raise ValueError(f"slot {slot} holds no pages to poison")
+        page = pages[pos // self.page_size]
+        off = pos % self.page_size
+        c = dict(self._cache)
+        if "k_scale" in c:  # int8 K can't hold NaN — poison the f32 scales
+            c["k_scale"] = c["k_scale"].at[page, :, off].set(jnp.nan)
+        else:
+            c["k"] = c["k"].at[page, :, off].set(jnp.nan)
+        self._cache = c
+
+    def scrub_slot(self, slot: int, from_pos: int = 0) -> None:
+        """Zero the slot's pages from the one covering ``from_pos`` on
+        (quarantine cleanup).  With ``from_pos`` = the delivery's prompt
+        length this scrubs exactly the decode-written region — pages that
+        are private by construction; earlier (possibly prefix-shared)
+        pages hold only finite prompt K/V and are left alone."""
+        pages = self._slot_pages.get(slot, [])
+        if not pages:
+            return
+        c = dict(self._cache)
+        for idx in range(from_pos // self.page_size, len(pages)):
+            page = pages[idx]
+            for key in c:
+                c[key] = c[key].at[page].set(0)
+        self._cache = c
 
     def release(self, slot: int) -> None:
         """Return the slot's pages to the pool.  Prefix-registered pages

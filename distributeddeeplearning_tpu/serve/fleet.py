@@ -1,0 +1,1004 @@
+"""Supervised multi-replica serving fleet: router + worker supervision.
+
+The scheduler (:mod:`.scheduler`) isolates failures *within* a replica —
+one request's deadline, NaN, or prefill error never kills the batch — but
+an engine process still dies with its process.  This module is the
+cross-process half of serving resilience (the analogue of ``ddlt train
+--max-restarts`` plus the control plane's resubmit loop on the training
+side):
+
+- :class:`FleetRouter` runs N **replica workers** (``multiprocessing``
+  spawn — each worker owns a full engine + scheduler in its own process,
+  the virtual-pod stand-in for N inference hosts), load-balances requests
+  onto the least-loaded live replica, and streams tokens/results back
+  over a shared outbox queue;
+- workers **heartbeat** once per decode step; the router detects death by
+  process exit code (a crash, an injected ``replica_death``, the
+  scheduler watchdog's exit 70) or by heartbeat staleness (a hang the
+  worker's own watchdog missed), restarts the replica up to
+  ``max_restarts`` times, and **requeues the dead replica's in-flight
+  requests** (onto survivors, or the restarted replica once it is up);
+- a requeued delivery carries the original prompt **plus every token
+  already streamed** (budget reduced by the same amount), so a greedy
+  retry continues the sequence bit-identically — decode is pinned
+  bit-exact against the full forward, which makes the fleet's output
+  under ``replica_death`` indistinguishable from a fault-free run.
+  Tokens lost in the dying process's pipe merely shorten the preserved
+  prefix; the retry regenerates them, so correctness never depends on
+  the dying worker flushing anything;
+- delivery is **at-most-K**: past ``max_redeliveries`` retries a request
+  finishes ``"error"`` and counts as *lost* (the number the chaos bench
+  gates at zero) instead of bouncing between dying replicas forever;
+- **graceful drain**: :meth:`FleetRouter.drain` (or SIGTERM via
+  :meth:`FleetRouter.install_signal_handler`) stops admission, lets
+  active requests finish on their replicas, returns queued ones as
+  ``"preempted"``, and the CLI exits
+  :data:`~..train.resilience.RESUMABLE_EXIT_CODE` (75) so the control
+  plane's resubmit path (PR 2) brings the fleet back — serving joins
+  the same exit-code contract as training.
+
+Fault injection: the router **deals** the ``DDLT_FAULTS`` spec across
+replicas (:func:`..utils.faults.deal_serve_faults` — serve-side kinds go
+to exactly one replica each, everything else replicates) and each worker
+installs its dealt slice via :func:`..utils.faults.install_plan`; a
+restarted replica gets its slice with ``replica_death`` stripped so an
+injected death is not replayed forever.
+
+Everything the router observes lands on the obs timeline
+(``fleet/replica_spawned`` / ``replica_died`` / ``replica_restarted`` /
+``request_requeued`` / ``request_lost`` / ``drain_begin``), so a merged
+trace shows every recovery next to the decode steps around it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import multiprocessing as mp
+import os
+import queue as queue_mod
+import signal
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from distributeddeeplearning_tpu.obs.registry import get_registry, summarize
+from distributeddeeplearning_tpu.obs.trace import get_tracer
+from distributeddeeplearning_tpu.serve.scheduler import (
+    CompletedRequest,
+    Request,
+)
+from distributeddeeplearning_tpu.utils import faults as faults_mod
+
+logger = logging.getLogger("ddlt.fleet")
+
+__all__ = ["ReplicaSpec", "FleetReport", "FleetRouter", "serve_fleet"]
+
+#: wire-uid separator: requests cross the process boundary as
+#: ``uid<SEP>delivery`` so a message from a superseded delivery (one that
+#: raced the replica's death) can never be stitched into the current one
+_SEP = "\x1f"
+
+
+@dataclasses.dataclass
+class ReplicaSpec:
+    """Everything a spawned worker needs to build its engine — plain
+    picklable data, because the worker process constructs the model and
+    engine itself (param pytrees never cross the process boundary).
+
+    ``model`` holds :func:`..models.pipelined_transformer.init_params`
+    kwargs (``num_layers``/``d_model``/``num_heads``/``d_ff``/
+    ``vocab_size``/``max_len``); with ``checkpoint_dir`` set the worker
+    restores params instead and ``model`` is ignored.  Every replica
+    builds the IDENTICAL model (same seed / same checkpoint) — the
+    failover bit-exactness story requires it.
+    """
+
+    model: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    seed: int = 0
+    checkpoint_dir: Optional[str] = None
+    quantize_weights: Optional[str] = None
+    num_heads: int = 4
+    batch_slots: int = 4
+    max_seq: int = 64
+    kv_layout: str = "paged"  # "paged" | "dense"
+    page_size: int = 16
+    num_pages: Optional[int] = None
+    prefill_chunk: int = 16
+    prefix_cache: bool = True          # paged engines only
+    prefill_attention: str = "flash"   # dense engines only
+    cache_dtype: Optional[str] = None  # e.g. "int8"
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    eos_id: Optional[int] = None
+    max_new_tokens: int = 32
+    request_deadline_s: Optional[float] = None
+    watchdog_deadline_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.kv_layout not in ("paged", "dense"):
+            raise ValueError(
+                f"kv_layout must be 'paged' or 'dense', got {self.kv_layout!r}"
+            )
+        if not self.checkpoint_dir and not self.model:
+            raise ValueError(
+                "ReplicaSpec needs either model dims or a checkpoint_dir"
+            )
+
+
+@dataclasses.dataclass
+class FleetReport:
+    """Fleet-level accounting — the ``SERVE_RESILIENCE`` artifact body.
+
+    Latency percentiles are measured on the ROUTER's clock (submit ->
+    first streamed token -> completion), so cross-replica failover time
+    and restart stalls are *inside* the numbers a client would feel, not
+    hidden in per-replica reports.
+    """
+
+    replicas: int
+    requests: int
+    generated_tokens: int
+    wall_s: float
+    goodput_tokens_per_sec: float  # tokens of OK requests / wall
+    completed_ok: int              # finish_reason in ("eos", "length")
+    errors: int
+    error_rate: float
+    finish_reasons: Dict[str, int]
+    ttft_s: Dict[str, float]
+    tpot_s: Dict[str, float]
+    restarts: int = 0
+    replica_deaths: int = 0
+    redeliveries: int = 0
+    lost_requests: int = 0     # redelivery budget exhausted
+    shed: int = 0              # admission-rejected deliveries observed
+    drained: bool = False
+    # final ServeReport dict per replica index for replicas that exited
+    # cleanly (a dead-and-not-restarted replica leaves None)
+    replica_reports: List[Optional[Dict[str, Any]]] = dataclasses.field(
+        default_factory=list
+    )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# -- worker side -----------------------------------------------------------
+
+
+def _build_engine(spec: ReplicaSpec):
+    """Construct this worker's engine from the spec (worker process only)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddeeplearning_tpu.serve.engine import (
+        PagedInferenceEngine,
+        data_parallel_engine,
+    )
+    from distributeddeeplearning_tpu.utils.hardware import (
+        enable_compilation_cache,
+    )
+
+    # every replica compiles the IDENTICAL programs (same spec), and a
+    # RESTARTED replica recompiles what its predecessor already built —
+    # the persistent cache turns both into loads.  Restart latency is
+    # recovery overhead, so this is a resilience knob, not a nicety;
+    # floor 0 so even sub-second CPU-smoke programs hit on restart.
+    enable_compilation_cache(0)
+
+    if spec.checkpoint_dir:
+        from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(spec.checkpoint_dir)
+        try:
+            params, _ = ckpt.restore_params(
+                quantize_weights=spec.quantize_weights
+            )
+        finally:
+            ckpt.close()
+        if params is None:
+            raise FileNotFoundError(
+                f"no checkpoint under {spec.checkpoint_dir}"
+            )
+    else:
+        from distributeddeeplearning_tpu.models.pipelined_transformer import (
+            init_params,
+        )
+
+        params = init_params(jax.random.key(spec.seed), **spec.model)
+        if spec.quantize_weights == "int8":
+            from distributeddeeplearning_tpu.quant.calibrate import (
+                quantize_params,
+            )
+
+            params = quantize_params(params)
+    cache_dtype = jnp.int8 if spec.cache_dtype == "int8" else None
+    if spec.kv_layout == "paged":
+        return PagedInferenceEngine(
+            params,
+            num_heads=spec.num_heads,
+            batch_slots=spec.batch_slots,
+            max_seq=spec.max_seq,
+            page_size=spec.page_size,
+            num_pages=spec.num_pages,
+            prefill_chunk=spec.prefill_chunk,
+            prefix_cache=spec.prefix_cache,
+            temperature=spec.temperature,
+            top_k=spec.top_k,
+            cache_dtype=cache_dtype,
+            rng=jax.random.key(spec.seed),
+        )
+    engine, _ = data_parallel_engine(
+        params,
+        num_heads=spec.num_heads,
+        batch_slots=spec.batch_slots,
+        max_seq=spec.max_seq,
+        prefill_attention=spec.prefill_attention,
+        temperature=spec.temperature,
+        top_k=spec.top_k,
+        cache_dtype=cache_dtype,
+        rng=jax.random.key(spec.seed),
+    )
+    return engine
+
+
+def _worker_main(
+    replica_id: int,
+    spec: ReplicaSpec,
+    faults_spec: str,
+    inbox,
+    outbox,
+    drain_event,
+) -> None:
+    """Replica worker entry point (runs in a spawned child process).
+
+    Builds the engine, then drives the scheduler in live mode: ``poll``
+    reads the inbox, every generated token / heartbeat / completion goes
+    out through the shared outbox.  The dealt fault slice is installed
+    OVER the inherited environment (every worker inherits the parent's
+    full ``DDLT_FAULTS``; without :func:`faults.install_plan` each would
+    fire every serve-side entry at its own local step).
+    """
+    plan = faults_mod.install_plan(faults_spec or "")
+
+    from distributeddeeplearning_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    try:
+        engine = _build_engine(spec)
+    except Exception as exc:  # noqa: BLE001 — report, then exit visibly
+        outbox.put(("spawn_error", replica_id, f"{type(exc).__name__}: {exc}"))
+        return
+    outbox.put(("ready", replica_id, time.time()))
+
+    closed = False
+    last_hb = 0.0
+
+    def poll() -> Optional[List[Request]]:
+        nonlocal closed, last_hb
+        # rate-limited liveness signal from the LOOP TOP, not just after
+        # decode steps: without it a worker grinding through a long
+        # chunked-prefill phase (each chunk's first-time compile blocks
+        # one iteration) sends nothing for the whole phase and a tight
+        # --heartbeat-timeout-s reads healthy work as a hang.  (A single
+        # blocking compile still gaps the stream — size the timeout
+        # above the worst-case compile, or leave it None and rely on the
+        # in-worker watchdog for hang detection.)
+        now = time.monotonic()
+        if now - last_hb > 0.25:
+            last_hb = now
+            outbox.put(("hb", replica_id, -1))
+        if closed:
+            return None
+        fresh: List[Request] = []
+        while True:
+            try:
+                msg = inbox.get_nowait()
+            except queue_mod.Empty:
+                break
+            if msg is None:  # close sentinel: finish what we hold
+                closed = True
+                break
+            fresh.append(
+                Request(
+                    uid=msg["uid"],
+                    prompt=msg["prompt"],
+                    max_new_tokens=msg.get("max_new_tokens"),
+                    deadline_s=msg.get("deadline_s"),
+                )
+            )
+        return None if (closed and not fresh) else fresh
+
+    def on_step(step: int) -> None:
+        outbox.put(("hb", replica_id, step))
+        if plan and plan.take_replica_death(step):
+            # hard death, mid-service: no drain, no goodbye message.  The
+            # flush below only models "bytes already on the wire arrive"
+            # (mp.Queue writes through a feeder thread; os._exit would
+            # drop its buffer) — correctness does not depend on it, a
+            # shorter preserved prefix just regenerates identically.
+            outbox.close()
+            outbox.join_thread()
+            os._exit(1)
+
+    def on_token(uid: str, token: int) -> None:
+        outbox.put(("token", replica_id, uid, int(token)))
+
+    def on_complete(result: CompletedRequest) -> None:
+        outbox.put(("done", replica_id, dataclasses.asdict(result)))
+
+    sched = ContinuousBatchingScheduler(
+        engine,
+        eos_id=spec.eos_id,
+        max_new_tokens=spec.max_new_tokens,
+        request_deadline_s=spec.request_deadline_s,
+        watchdog_deadline_s=spec.watchdog_deadline_s,
+        # every result streams out through on_complete as it lands; the
+        # worker may live for days, so it keeps only a window for its
+        # exit report instead of every token it ever generated
+        result_window=10_000,
+    )
+    try:
+        _, report = sched.run(
+            [],
+            poll=poll,
+            should_drain=drain_event.is_set,
+            on_token=on_token,
+            on_step=on_step,
+            on_complete=on_complete,
+        )
+    except BaseException as exc:  # noqa: BLE001 — visible death > silent
+        outbox.put(("crash", replica_id, f"{type(exc).__name__}: {exc}"))
+        raise
+    outbox.put(("exit", replica_id, report.to_dict()))
+
+
+# -- router side -----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Replica:
+    """Router-side view of one worker process."""
+
+    index: int                      # stable replica index (0..N-1)
+    proc: Any
+    inbox: Any
+    faults_spec: str
+    spawned_at: float = 0.0         # arms the spawn-hang bound
+    outstanding: set = dataclasses.field(default_factory=set)  # uids
+    restarts_used: int = 0
+    ready: bool = False             # engine built, scheduler loop live
+    last_msg_at: Optional[float] = None  # arms heartbeat staleness
+    exit_seen_at: Optional[float] = None  # clean-exit grace clock
+    dead: bool = False              # terminal (death or retirement)
+    report: Optional[Dict[str, Any]] = None
+
+
+@dataclasses.dataclass
+class _Flight:
+    """Router-side lifecycle of one request uid.
+
+    ``preserved`` holds tokens committed by PRIOR (dead/shed) deliveries;
+    ``streamed`` holds tokens streamed by the CURRENT delivery.  On death
+    the current stream is committed into ``preserved`` and rides the
+    retry's prompt; on completion the worker's own token list for the
+    delivery is authoritative and ``streamed`` (a prefix of it) is
+    dropped — never both, so nothing double-counts.
+    """
+
+    req: Request
+    submitted_at: float
+    # absolute (router-clock) deadline: fixed at submit so a redelivery
+    # ships only the REMAINING window — re-basing would grant each
+    # failover a fresh full deadline
+    deadline_at: Optional[float] = None
+    preserved: List[int] = dataclasses.field(default_factory=list)
+    streamed: List[int] = dataclasses.field(default_factory=list)
+    delivery: int = 0               # current delivery number (1-based)
+    replica: Optional[int] = None   # index currently serving, if any
+    avoid: Optional[int] = None     # replica that just shed this uid
+    first_token_at: Optional[float] = None
+    done: bool = False              # terminal: finalized exactly once
+
+    def wire_uid(self) -> str:
+        return f"{self.req.uid}{_SEP}{self.delivery}"
+
+
+class FleetRouter:
+    """Run ``replicas`` engine workers and serve a request stream across
+    them with health-checked supervision and request failover.
+
+    ``faults`` overrides the ``DDLT_FAULTS`` environment for dealing
+    across workers (tests/bench pass it explicitly; ``None`` reads the
+    environment so the CLI inherits the usual grammar).
+    """
+
+    def __init__(
+        self,
+        spec: ReplicaSpec,
+        *,
+        replicas: int = 2,
+        max_restarts: int = 1,
+        max_redeliveries: int = 2,
+        heartbeat_timeout_s: Optional[float] = None,
+        faults: Optional[str] = None,
+    ):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+        if max_redeliveries < 1:
+            raise ValueError(
+                f"max_redeliveries must be >= 1, got {max_redeliveries}"
+            )
+        if heartbeat_timeout_s is not None and heartbeat_timeout_s <= 0:
+            raise ValueError(
+                f"heartbeat_timeout_s must be > 0, got {heartbeat_timeout_s}"
+            )
+        self.spec = spec
+        self.replicas = replicas
+        self.max_restarts = max_restarts
+        self.max_redeliveries = max_redeliveries
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        faults_text = (
+            faults if faults is not None
+            else os.environ.get(faults_mod.ENV_VAR, "")
+        )
+        self._dealt = faults_mod.deal_serve_faults(faults_text, replicas)
+        # spawn context: workers must re-import jax fresh — a fork would
+        # clone a parent whose XLA runtime threads are mid-flight
+        self._ctx = mp.get_context("spawn")
+        self._drain_event = self._ctx.Event()
+        self._outbox = self._ctx.Queue()
+        self._members: List[_Replica] = []
+        self.restarts = 0
+        self.replica_deaths = 0
+        self.redeliveries = 0
+        self.lost_requests = 0
+        self.shed_seen = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _spawn(self, index: int, faults_spec: str) -> _Replica:
+        inbox = self._ctx.Queue()
+        proc = self._ctx.Process(
+            target=_worker_main,
+            args=(
+                index, self.spec, faults_spec, inbox, self._outbox,
+                self._drain_event,
+            ),
+            name=f"ddlt-serve-replica-{index}",
+            daemon=True,
+        )
+        proc.start()
+        get_tracer().event(
+            "fleet/replica_spawned", cat="fleet", replica=index,
+            pid=proc.pid, faults=faults_spec,
+        )
+        logger.info("replica %d spawned (pid %s)", index, proc.pid)
+        return _Replica(
+            index=index, proc=proc, inbox=inbox, faults_spec=faults_spec,
+            spawned_at=time.perf_counter(),
+        )
+
+    def drain(self) -> None:
+        """Begin graceful drain: workers stop admitting and finish their
+        active requests; the router returns queued work ``"preempted"``."""
+        if not self._drain_event.is_set():
+            get_tracer().event("fleet/drain_begin", cat="fleet")
+            logger.warning("fleet drain begun")
+            self._drain_event.set()
+
+    def install_signal_handler(
+        self, signals: Sequence[int] = (signal.SIGTERM,)
+    ) -> None:
+        """SIGTERM -> drain (main thread only; the serving half of the
+        exit-75 contract — the CLI exits RESUMABLE_EXIT_CODE after a
+        drained ``serve`` so the control plane resubmits the fleet)."""
+        for sig in signals:
+            signal.signal(sig, lambda *_: self.drain())
+
+    # -- serving -----------------------------------------------------------
+
+    def serve(
+        self, requests: Sequence[Request]
+    ) -> tuple[List[CompletedRequest], FleetReport]:
+        """Serve every request across the fleet; returns (results, report).
+
+        Results preserve completion order.  Blocks until every request
+        reaches a terminal state (or the fleet drains), then shuts the
+        workers down gracefully.
+        """
+        trace = get_tracer()
+        t_start = time.perf_counter()
+        self._members = [
+            self._spawn(i, self._dealt[i]) for i in range(self.replicas)
+        ]
+        flights: Dict[str, _Flight] = {}
+        backlog: List[str] = []  # uids waiting for a live replica
+        results: List[CompletedRequest] = []
+        finish_reasons: Dict[str, int] = {}
+        now = time.perf_counter()
+        for req in requests:
+            if req.uid in flights:
+                raise ValueError(f"duplicate request uid {req.uid!r}")
+            if _SEP in req.uid:
+                raise ValueError(
+                    f"request uid {req.uid!r} contains the reserved "
+                    "delivery separator"
+                )
+            deadline_s = (
+                req.deadline_s
+                if req.deadline_s is not None
+                else self.spec.request_deadline_s
+            )
+            flights[req.uid] = _Flight(
+                req=req,
+                submitted_at=now,
+                deadline_at=(
+                    now + deadline_s if deadline_s is not None else None
+                ),
+            )
+            backlog.append(req.uid)
+
+        def finalize(uid: str, payload: Dict[str, Any]) -> None:
+            """Stitch a terminal result into the router view (idempotent:
+            a death can race a completion — e.g. the worker's 'done' is
+            harvested by the death's drain_burst AFTER the member was
+            marked dead, so its outstanding set still holds the uid and
+            handle_death would try to redeliver finished work)."""
+            fl = flights[uid]
+            if fl.done:
+                return
+            fl.done = True
+            fl.replica = None
+            done_at = time.perf_counter()
+            ttft = (
+                fl.first_token_at - fl.submitted_at
+                if fl.first_token_at is not None
+                else 0.0
+            )
+            res = CompletedRequest(
+                uid=uid,
+                prompt_len=len(fl.req.prompt),
+                # "preempted" promises no tokens (resubmit replays the
+                # whole request) — drop a dead delivery's preserved stream
+                tokens=(
+                    fl.preserved + list(payload["tokens"])
+                    if payload["finish_reason"] != "preempted"
+                    else []
+                ),
+                finish_reason=payload["finish_reason"],
+                ttft_s=round(ttft, 6),
+                total_s=round(done_at - fl.submitted_at, 6),
+                error=payload.get("error"),
+                queue_wait_s=payload.get("queue_wait_s", 0.0),
+            )
+            results.append(res)
+            finish_reasons[res.finish_reason] = (
+                finish_reasons.get(res.finish_reason, 0) + 1
+            )
+
+        def redeliver(uid: str, why: str, avoid: Optional[int]) -> None:
+            """Requeue one in-flight uid after a replica death or a shed
+            — at most ``max_redeliveries`` retries, the current stream
+            committed into ``preserved`` so the retry continues the
+            sequence bit-identically."""
+            fl = flights[uid]
+            if fl.done:
+                return  # completion already raced in — nothing to redo
+            fl.preserved = fl.preserved + fl.streamed
+            fl.streamed = []
+            fl.replica = None
+            fl.avoid = avoid
+            budget = (
+                fl.req.max_new_tokens
+                if fl.req.max_new_tokens is not None
+                else self.spec.max_new_tokens
+            )
+            eos = self.spec.eos_id
+            if len(fl.preserved) >= budget or (
+                eos is not None and fl.preserved and fl.preserved[-1] == eos
+            ):
+                # the dead worker had already streamed the whole answer —
+                # only its 'done' was lost.  A retry would ship
+                # max_new_tokens=0 (worker-crashing) or decode past EOS
+                # (bit-exactness-breaking); the stream itself is the
+                # complete result, so finish it here.
+                finalize(uid, {
+                    "tokens": [],
+                    "finish_reason": (
+                        "eos"
+                        if eos is not None
+                        and fl.preserved
+                        and fl.preserved[-1] == eos
+                        else "length"
+                    ),
+                })
+                return
+            if fl.delivery - 1 >= self.max_redeliveries:
+                self.lost_requests += 1
+                trace.event(
+                    "fleet/request_lost", cat="fleet", uid=uid, reason=why,
+                )
+                finalize(uid, {
+                    "tokens": [],
+                    "finish_reason": "error",
+                    "error": (
+                        f"redelivery budget spent "
+                        f"({self.max_redeliveries}) after {why}"
+                    ),
+                })
+                return
+            self.redeliveries += 1
+            trace.event(
+                "fleet/request_requeued", cat="fleet", uid=uid,
+                reason=why, preserved_tokens=len(fl.preserved),
+                delivery=fl.delivery,
+            )
+            backlog.append(uid)
+
+        def deliver(member: _Replica, uid: str) -> None:
+            fl = flights[uid]
+            fl.delivery += 1
+            fl.replica = member.index
+            member.outstanding.add(uid)
+            budget = (
+                fl.req.max_new_tokens
+                if fl.req.max_new_tokens is not None
+                else self.spec.max_new_tokens
+            )
+            member.inbox.put({
+                "uid": fl.wire_uid(),
+                # failover continuation: everything already streamed rides
+                # in the prompt; greedy decode then reproduces the
+                # fault-free stream exactly (decode == full forward)
+                "prompt": list(fl.req.prompt) + fl.preserved,
+                "max_new_tokens": budget - len(fl.preserved),
+                # only the REMAINING window: the worker re-bases from its
+                # own arrival clock, so shipping the raw relative value
+                # would hand every redelivery a fresh full deadline
+                "deadline_s": (
+                    fl.deadline_at - time.perf_counter()
+                    if fl.deadline_at is not None
+                    else None
+                ),
+            })
+
+        def current_flight(wire_uid: str) -> Optional[_Flight]:
+            """Resolve a wire uid; None for a superseded delivery."""
+            uid, _, delivery = wire_uid.rpartition(_SEP)
+            fl = flights.get(uid)
+            if fl is None or str(fl.delivery) != delivery:
+                return None  # raced a death: the delivery was replaced
+            return fl
+
+        def process(msg) -> None:
+            kind, rid = msg[0], msg[1]
+            member = next(
+                (m for m in self._members
+                 if m.index == rid and not m.dead),
+                None,
+            )
+            if member is not None:
+                member.last_msg_at = time.perf_counter()
+            if kind == "token":
+                fl = current_flight(msg[2])
+                if fl is not None and fl.replica == rid:
+                    if fl.first_token_at is None:
+                        fl.first_token_at = time.perf_counter()
+                    fl.streamed.append(msg[3])
+            elif kind == "done":
+                payload = msg[2]
+                fl = current_flight(payload["uid"])
+                if fl is None or fl.replica != rid:
+                    return  # stale result from a superseded delivery
+                if member is not None:
+                    member.outstanding.discard(fl.req.uid)
+                # the worker's token list for this delivery subsumes the
+                # streamed prefix — drop the stream, keep the authority
+                fl.streamed = []
+                if payload["finish_reason"] == "shed":
+                    self.shed_seen += 1
+                    redeliver(
+                        fl.req.uid, f"shed by replica {rid}", avoid=rid,
+                    )
+                    return
+                finalize(fl.req.uid, payload)
+            elif kind == "exit":
+                if member is not None:
+                    member.report = msg[2]
+            elif kind == "spawn_error":
+                # engine build failed: the worker reports and exits 0, so
+                # the exit-code poll would read it as a CLEAN exit and
+                # retire the replica without ever spending its restart
+                # budget — treat the message itself as the death signal
+                # (transient causes, e.g. a replicated io_error hitting
+                # checkpoint restore, deserve the restart)
+                logger.warning("replica %d spawn_error: %s", rid, msg[2])
+                if member is not None:
+                    handle_death(member, f"spawn_error: {msg[2]}")
+            elif kind == "crash":
+                # informational: the non-zero exit code is the reliable
+                # death signal (the process is mid-raise right now)
+                logger.warning("replica %d crash: %s", rid, msg[2])
+            elif kind == "ready" and member is not None:
+                member.ready = True
+            # "hb" carries no payload beyond liveness, handled above
+
+        def drain_burst(budget_s: float = 0.3) -> None:
+            """Opportunistically process already-flushed messages — called
+            on a death so tokens the dying worker got onto the wire are
+            harvested into ``streamed`` before the requeue commits them."""
+            deadline = time.monotonic() + budget_s
+            while time.monotonic() < deadline:
+                try:
+                    process(self._outbox.get(timeout=0.02))
+                except queue_mod.Empty:
+                    break
+
+        def handle_death(member: _Replica, how: str) -> None:
+            member.dead = True
+            self.replica_deaths += 1
+            drain_burst()  # harvest the pipe before committing streams
+            trace.event(
+                "fleet/replica_died", cat="fleet", replica=member.index,
+                how=how, outstanding=len(member.outstanding),
+                restarts_used=member.restarts_used,
+            )
+            logger.warning(
+                "replica %d died (%s) with %d request(s) in flight",
+                member.index, how, len(member.outstanding),
+            )
+            orphans = sorted(member.outstanding)
+            member.outstanding.clear()
+            for uid in orphans:
+                redeliver(
+                    uid, f"replica {member.index} died ({how})",
+                    avoid=None,
+                )
+            if (
+                member.restarts_used < self.max_restarts
+                and not self._drain_event.is_set()
+            ):
+                # the restarted process must not replay its own injected
+                # death forever — strip replica_death from its slice
+                respec = faults_mod.strip_kinds(
+                    member.faults_spec, ("replica_death",)
+                )
+                fresh = self._spawn(member.index, respec)
+                fresh.restarts_used = member.restarts_used + 1
+                self.restarts += 1
+                trace.event(
+                    "fleet/replica_restarted", cat="fleet",
+                    replica=member.index, attempt=fresh.restarts_used,
+                )
+                self._members[self._members.index(member)] = fresh
+
+        def retire(member: _Replica) -> None:
+            """Clean exit (code 0, nothing outstanding): not a death."""
+            member.dead = True
+
+        # --- dispatch loop ------------------------------------------------
+        # Host bookkeeping only: queue pumps, health checks, least-loaded
+        # dispatch.  The one blocking call is the outbox get with a short
+        # timeout (the router's idle wait, not a device sync) — the
+        # hot-loop lint greps this region like the trainer/scheduler
+        # loops.
+        while len(results) < len(flights):
+            live = [m for m in self._members if not m.dead]
+            if self._drain_event.is_set() and backlog:
+                # router-held work the drain will never admit: hand it to
+                # the control plane's resubmit path.  NOT one-shot — a
+                # replica dying DURING the drain redelivers its orphans
+                # into the backlog, and with every dispatch branch gated
+                # off by the drain nothing else would ever consume them
+                # (the loop would spin forever on len(results))
+                for uid in backlog:
+                    finalize(uid, {
+                        "tokens": [], "finish_reason": "preempted",
+                    })
+                backlog.clear()
+            if backlog and not live and not self._drain_event.is_set():
+                # no replica left and no restart budget: fail the
+                # stranded requests loudly instead of spinning forever
+                for uid in backlog:
+                    self.lost_requests += 1
+                    trace.event(
+                        "fleet/request_lost", cat="fleet", uid=uid,
+                        reason="no live replica",
+                    )
+                    finalize(uid, {
+                        "tokens": [], "finish_reason": "error",
+                        "error": "no live replica (restart budget spent)",
+                    })
+                backlog.clear()
+            if backlog and live and not self._drain_event.is_set():
+                held: List[str] = []
+                # only READY replicas take work: a request put on a
+                # still-spawning replica's inbox would sit unserved
+                # through its whole jax import + engine build while a
+                # live replica idles (holding at the router keeps the
+                # choice open until somebody can actually serve)
+                ready = [m for m in live if m.ready]
+                for uid in backlog:
+                    fl = flights[uid]
+                    if (
+                        fl.deadline_at is not None
+                        and time.perf_counter() > fl.deadline_at
+                    ):
+                        # expired while router-held (e.g. waiting out a
+                        # restart): same terminal state the worker would
+                        # give it, without burning a delivery
+                        finalize(uid, {
+                            "tokens": [], "finish_reason": "deadline",
+                        })
+                        continue
+                    if not ready:
+                        held.append(uid)
+                        continue
+                    pool = [
+                        m for m in ready if m.index != fl.avoid
+                    ] or ready  # avoid the shedder unless it is all we have
+                    target = min(
+                        pool, key=lambda m: (len(m.outstanding), m.index)
+                    )
+                    # cap in-flight per replica at slots + a small ready
+                    # queue: enough to keep the worker's admission loop
+                    # fed, small enough that a death orphans (and redoes)
+                    # at most one batch's worth of work
+                    if len(target.outstanding) >= self.spec.batch_slots + 2:
+                        held.append(uid)  # every replica saturated: hold
+                        continue
+                    deliver(target, uid)
+                backlog[:] = held
+            if len(results) >= len(flights):
+                break
+            try:
+                process(self._outbox.get(timeout=0.05))
+            except queue_mod.Empty:
+                pass
+            now = time.perf_counter()
+            for member in list(self._members):
+                if member.dead:
+                    continue
+                code = member.proc.exitcode
+                if code is not None:
+                    if code != 0:
+                        handle_death(member, f"exit code {code}")
+                    else:
+                        # clean exit: give the pipe a grace period to
+                        # deliver trailing done/exit messages, then treat
+                        # a still-outstanding request set as a death
+                        if member.exit_seen_at is None:
+                            member.exit_seen_at = now
+                        if not member.outstanding and member.report is not None:
+                            retire(member)
+                        elif now - member.exit_seen_at > 2.0:
+                            if member.outstanding:
+                                handle_death(member, "clean exit mid-flight")
+                            else:
+                                retire(member)
+                elif (
+                    self.heartbeat_timeout_s is not None
+                    and member.last_msg_at is not None
+                    and member.outstanding
+                    and now - member.last_msg_at > self.heartbeat_timeout_s
+                ):
+                    member.proc.terminate()
+                    member.proc.join(timeout=5.0)
+                    handle_death(member, "heartbeat timeout")
+                elif (
+                    self.heartbeat_timeout_s is not None
+                    and not member.ready
+                    and member.last_msg_at is None
+                    and now - member.spawned_at
+                    > self.heartbeat_timeout_s + 180.0
+                ):
+                    # hung BEFORE the first message (stuck checkpoint
+                    # restore / jax init): no heartbeat ever arms the
+                    # staleness check above and no work is outstanding,
+                    # so without this bound the router would hold its
+                    # backlog for this replica forever.  The fixed +180 s
+                    # allowance covers a legitimate cold engine build.
+                    member.proc.terminate()
+                    member.proc.join(timeout=5.0)
+                    handle_death(member, "spawn hang")
+
+        # --- shutdown: close inboxes, join workers, collect reports ------
+        # A replica still mid-spawn (restarted near the end, engine not
+        # built) is terminated instead of joined: every result is already
+        # in, and waiting out a full jax import + engine compile would
+        # bill cold-start arithmetic to the serving wall (its
+        # replica_reports entry stays None).
+        for member in self._members:
+            if not member.dead:
+                try:
+                    member.inbox.put(None)
+                except (ValueError, OSError):
+                    pass
+        deadline = time.monotonic() + 60.0
+        for member in self._members:
+            if member.dead:
+                continue
+            if not member.ready:
+                member.proc.terminate()
+                member.proc.join(timeout=5.0)
+                continue
+            member.proc.join(timeout=max(0.5, deadline - time.monotonic()))
+            if member.proc.exitcode is None:
+                member.proc.terminate()
+                member.proc.join(timeout=5.0)
+        while True:  # buffered trailing exit reports
+            try:
+                msg = self._outbox.get_nowait()
+            except queue_mod.Empty:
+                break
+            if msg[0] == "exit":
+                for member in self._members:
+                    if member.index == msg[1] and member.report is None:
+                        member.report = msg[2]
+
+        wall = time.perf_counter() - t_start
+        ok = [r for r in results if r.finish_reason in ("eos", "length")]
+        errors = sum(1 for r in results if r.finish_reason == "error")
+        generated = sum(len(r.tokens) for r in results)
+        good_tokens = sum(len(r.tokens) for r in ok)
+        tpot = [
+            (r.total_s - r.ttft_s) / (len(r.tokens) - 1)
+            for r in ok
+            if len(r.tokens) >= 2
+        ]
+        report = FleetReport(
+            replicas=self.replicas,
+            requests=len(flights),
+            generated_tokens=generated,
+            wall_s=round(wall, 4),
+            goodput_tokens_per_sec=(
+                round(good_tokens / wall, 2) if wall > 0 else 0.0
+            ),
+            completed_ok=len(ok),
+            errors=errors,
+            error_rate=round(errors / len(flights), 4) if flights else 0.0,
+            finish_reasons=finish_reasons,
+            ttft_s=summarize([r.ttft_s for r in ok]),
+            tpot_s=summarize(tpot),
+            restarts=self.restarts,
+            replica_deaths=self.replica_deaths,
+            redeliveries=self.redeliveries,
+            lost_requests=self.lost_requests,
+            shed=self.shed_seen,
+            drained=self._drain_event.is_set(),
+            replica_reports=[m.report for m in self._members],
+        )
+        reg = get_registry()
+        reg.counter("fleet.replica_deaths").inc(self.replica_deaths)
+        reg.counter("fleet.restarts").inc(self.restarts)
+        reg.counter("fleet.redeliveries").inc(self.redeliveries)
+        reg.counter("fleet.lost_requests").inc(self.lost_requests)
+        return results, report
+
+
+def serve_fleet(
+    spec: ReplicaSpec,
+    requests: Sequence[Request],
+    *,
+    replicas: int = 2,
+    max_restarts: int = 1,
+    max_redeliveries: int = 2,
+    heartbeat_timeout_s: Optional[float] = None,
+    faults: Optional[str] = None,
+    install_signals: bool = False,
+) -> tuple[List[CompletedRequest], FleetReport]:
+    """One-call fleet serving (the ``ddlt serve --replicas N`` body)."""
+    router = FleetRouter(
+        spec,
+        replicas=replicas,
+        max_restarts=max_restarts,
+        max_redeliveries=max_redeliveries,
+        heartbeat_timeout_s=heartbeat_timeout_s,
+        faults=faults,
+    )
+    if install_signals:
+        router.install_signal_handler()
+    return router.serve(requests)

@@ -41,6 +41,37 @@ Every percentile block routes through the obs histogram
 spans/events on the obs tracer (no-ops unless a driver enabled it), and
 aggregate counters/histograms feed the process metrics registry once per
 ``run()``.
+
+Resilience (PR 7) — the scheduler is also the serving stack's blast-radius
+boundary; every failure mode is scoped to ONE request, never the batch:
+
+- **deadlines**: a request past its (absolute) deadline finishes
+  ``"deadline"`` — queued requests without admission, active requests
+  mid-decode with their partial tokens, the slot freed through the normal
+  ``release`` path so shared prefix pages are untouched;
+- **cancellation**: :meth:`~ContinuousBatchingScheduler.request_cancel`
+  marks a uid; it finishes ``"cancelled"`` at the next loop boundary;
+- **NaN quarantine**: engines report per-slot logit finiteness
+  (``engine.last_finite``, computed in-jit alongside sampling); a
+  non-finite slot is scrubbed (``engine.scrub_slot``) and fails alone
+  while the rest of the batch decodes on;
+- **decode-exception requeue**: an exception out of ``engine.decode``
+  itself (not a per-request failure) requeues every surviving slot ONCE
+  — prompt extended by the tokens already generated, budget reduced, the
+  preserved tokens stitched back into the final result — instead of
+  failing the whole batch;
+- **watchdog**: ``watchdog_deadline_s`` arms a
+  :class:`~..train.resilience.StepWatchdog` over the loop (hung decode
+  dispatch -> stack dump + exit 70, so a fleet supervisor restarts the
+  worker);
+- **live serving + drain**: ``run(poll=...)`` keeps the loop alive on an
+  external request source; ``should_drain`` stops admission, finishes the
+  active requests and returns queued ones as ``"preempted"`` — the
+  SIGTERM half of the serving exit-75 contract.
+
+Deterministic chaos for all of it comes from ``DDLT_FAULTS``
+(``decode_nan`` / ``decode_stall`` / ``reject_admit`` — see
+:mod:`..utils.faults`).
 """
 
 from __future__ import annotations
@@ -48,23 +79,41 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import deque
-from typing import Any, Dict, Iterable, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
-from distributeddeeplearning_tpu.obs.registry import get_registry, summarize
+from distributeddeeplearning_tpu.obs.registry import (
+    Histogram,
+    get_registry,
+    summarize,
+)
 from distributeddeeplearning_tpu.obs.trace import get_tracer
 from distributeddeeplearning_tpu.serve.engine import InferenceEngine
+from distributeddeeplearning_tpu.utils import faults as faults_mod
 
 
 @dataclasses.dataclass
 class Request:
     """One generation request: a token-id prompt plus an optional
-    per-request token budget (falls back to the scheduler default)."""
+    per-request token budget (falls back to the scheduler default) and an
+    optional deadline (seconds from intake; falls back to the scheduler's
+    ``request_deadline_s``)."""
 
     uid: str
     prompt: Sequence[int]
     max_new_tokens: Optional[int] = None
+    deadline_s: Optional[float] = None
+
+
+#: terminal states a request can reach (``CompletedRequest.finish_reason``)
+FINISH_REASONS = (
+    "eos", "length", "error", "step_cap", "cancelled",
+    "deadline",   # request ran past its deadline (partial tokens kept)
+    "shed",       # admission rejected under overload (reject_admit fault
+    #               or router-level backpressure) — safe to retry elsewhere
+    "preempted",  # drain: the scheduler is shutting down; never started
+)
 
 
 @dataclasses.dataclass
@@ -72,7 +121,7 @@ class CompletedRequest:
     uid: str
     prompt_len: int
     tokens: List[int]
-    finish_reason: str  # "eos" | "length" | "error" | "step_cap" | "cancelled"
+    finish_reason: str  # one of FINISH_REASONS
     ttft_s: float
     total_s: float
     error: Optional[str] = None  # set when finish_reason == "error"
@@ -87,6 +136,22 @@ class _SlotState:
     next_pos: int  # position the NEXT decode input token occupies
     ttft_s: float
     queue_wait_s: float = 0.0
+    deadline_at: Optional[float] = None  # absolute perf_counter deadline
+
+
+@dataclasses.dataclass
+class _ReqMeta:
+    """Cross-delivery bookkeeping for one uid: survives a decode-exception
+    requeue, so the final :class:`CompletedRequest` reports the ORIGINAL
+    prompt length, the stitched token stream, and first-delivery latency."""
+
+    arrival: float
+    orig_prompt_len: int
+    deadline_at: Optional[float] = None
+    preserved: List[int] = dataclasses.field(default_factory=list)
+    ttft_s: Optional[float] = None
+    queue_wait_s: Optional[float] = None
+    decode_retries: int = 0
 
 
 @dataclasses.dataclass
@@ -126,6 +191,13 @@ class ServeReport:
     # peak bytes committed to live sequences — equals kv_bytes under the
     # dense layout (the whole reservation is always committed)
     kv_bytes_peak: int = 0
+    # resilience accounting (PR 7): slots re-queued after a decode-step
+    # exception, requests failed alone by the NaN quarantine, and whether
+    # the run ended in a drain (SIGTERM/preemption — queued requests were
+    # returned "preempted" for the control plane to resubmit)
+    decode_retries: int = 0
+    quarantined: int = 0
+    drained: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -187,11 +259,19 @@ class ContinuousBatchingScheduler:
         eos_id: Optional[int] = None,
         max_new_tokens: int = 32,
         step_cap: Optional[int] = None,
+        request_deadline_s: Optional[float] = None,
+        watchdog_deadline_s: Optional[float] = None,
+        watchdog_on_timeout: Optional[Callable[[], None]] = None,
+        result_window: Optional[int] = None,
     ):
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if step_cap is not None and step_cap < 1:
             raise ValueError("step_cap must be >= 1")
+        if request_deadline_s is not None and request_deadline_s <= 0:
+            raise ValueError(
+                f"request_deadline_s must be > 0, got {request_deadline_s}"
+            )
         self.engine = engine
         self.eos_id = eos_id
         self.max_new_tokens = max_new_tokens
@@ -199,6 +279,40 @@ class ContinuousBatchingScheduler:
         # complete as "step_cap" and unstarted requests as "cancelled",
         # so a scheduler/allocator regression can never hang CI
         self.step_cap = step_cap
+        # default per-request deadline (Request.deadline_s overrides);
+        # None = requests may run forever
+        self.request_deadline_s = request_deadline_s
+        # hot-loop watchdog (reuses train/resilience.StepWatchdog): if the
+        # loop makes no progress for this long — a hung decode dispatch,
+        # a dead collective — stacks are dumped and the process exits 70
+        # so a supervisor (the fleet router, ddlt's control plane)
+        # restarts it.  ``watchdog_on_timeout`` overrides the exit for
+        # embedding/tests.
+        self.watchdog_deadline_s = watchdog_deadline_s
+        self.watchdog_on_timeout = watchdog_on_timeout
+        # live-mode memory bound: keep only the last N CompletedRequests
+        # (a fleet worker serving an open-ended stream already ships every
+        # result out through on_complete — retaining all of them forever
+        # would grow without bound).  None = retain everything (batch
+        # semantics; run()'s return value is the full result set).
+        # Aggregate counters (requests/tokens/finish_reasons) stay exact
+        # either way; end-of-run percentiles cover the retained window.
+        if result_window is not None and result_window < 1:
+            raise ValueError(
+                f"result_window must be >= 1, got {result_window}"
+            )
+        self.result_window = result_window
+        self._cancelled: set = set()
+
+    def request_cancel(self, uid: str) -> None:
+        """Mark ``uid`` for cancellation; it finishes ``"cancelled"`` at
+        the next loop boundary (queued: without admission; active: with
+        its partial tokens, the slot freed through the normal release
+        path).  A mark may arrive BEFORE the request itself (live mode:
+        the cancel can beat the poll) — it waits and applies at intake.
+        Safe to call from another thread: set add/discard are atomic and
+        the loop never iterates the set while it could shrink."""
+        self._cancelled.add(uid)
 
     def _finished(self, st: _SlotState) -> Optional[str]:
         if self.eos_id is not None and st.generated[-1] == self.eos_id:
@@ -210,13 +324,35 @@ class ContinuousBatchingScheduler:
         return None
 
     def run(
-        self, requests: Iterable[Request]
+        self,
+        requests: Iterable[Request],
+        *,
+        poll: Optional[Callable[[], Optional[List[Request]]]] = None,
+        should_drain: Optional[Callable[[], bool]] = None,
+        on_token: Optional[Callable[[str, int], None]] = None,
+        on_step: Optional[Callable[[int], None]] = None,
+        on_complete: Optional[Callable[[CompletedRequest], None]] = None,
     ) -> tuple[List[CompletedRequest], ServeReport]:
         """Serve every request to completion; returns (results, report).
 
         Results preserve completion order (not submission order) — the
         continuous-batching signature: short requests admitted late can
         finish before long ones admitted early.
+
+        Live-serving hooks (all optional; a fleet worker wires every one):
+
+        - ``poll()`` is called once per loop iteration; it returns newly
+          arrived requests (may be empty), or None meaning the source is
+          closed — the loop then finishes what it holds and returns.
+          With a ``poll`` the loop stays alive while idle.
+        - ``should_drain()`` -> True stops admission: queued/mid-prefill
+          requests finish ``"preempted"`` (no tokens — a control plane
+          resubmits them), active requests decode to completion.
+        - ``on_token(uid, token)`` streams each generated token.
+        - ``on_step(decode_step)`` fires after each decode step
+          (heartbeats, fault hooks).
+        - ``on_complete(result)`` fires as each request reaches a
+          terminal state (the same objects ``run`` returns).
         """
         engine = self.engine
         slots = engine.batch_slots
@@ -228,17 +364,10 @@ class ContinuousBatchingScheduler:
         # duck-typed engines (test fakes) may not implement the release
         # verb; dense engines no-op it anyway
         release = getattr(engine, "release", lambda _slot: None)
-        pending = deque(requests)
-        for r in pending:
-            # explicit None-check: a falsy 0 must not silently inherit the
-            # scheduler default (it is rejected, matching the class's own
-            # max_new_tokens validation)
-            if r.max_new_tokens is not None and r.max_new_tokens < 1:
-                raise ValueError(
-                    f"request {r.uid}: max_new_tokens must be >= 1, "
-                    f"got {r.max_new_tokens}"
-                )
-        n_requests = len(pending)
+        # deterministic chaos (decode_nan / decode_stall / reject_admit);
+        # falsy when DDLT_FAULTS is empty, so the hot loop pays one
+        # truthiness check
+        plan = faults_mod.get_plan()
         compiles_before = getattr(engine, "prefill_compiles", 0)
         t_start = time.perf_counter()
 
@@ -248,13 +377,25 @@ class ContinuousBatchingScheduler:
         prefilling: deque = deque()
         tokens_buf = np.zeros(slots, np.int32)
         pos_buf = np.zeros(slots, np.int32)
-        results: List[CompletedRequest] = []
-        step_times: List[float] = []
-        occupancy: List[float] = []
+        # bounded when result_window is set (live mode) — see __init__.
+        # Per-step timing/occupancy feed ONLY end-of-run aggregates, so
+        # they stream into the obs histogram / running sums (O(1) memory
+        # — a long-lived worker would otherwise grow raw sample lists
+        # forever; this is also THE percentile implementation every
+        # report block already routes through)
+        results: deque = deque(maxlen=self.result_window)
+        step_hist = Histogram("serve.decode_step_s")
+        occ_sum = 0.0
+        occ_n = 0               # attempted decode steps (incl. failed)
+        n_decode_steps = 0      # exact count
+        generated_count = 0     # exact token total (results may be windowed)
         prompt_tokens = 0
         finish_reasons: Dict[str, int] = {}
+        meta: Dict[str, _ReqMeta] = {}
 
         error_count = 0
+        quarantined = 0
+        decode_retries = 0
 
         def budget_of(req: Request) -> int:
             return (
@@ -263,30 +404,61 @@ class ContinuousBatchingScheduler:
                 else self.max_new_tokens
             )
 
+        def finish(result: CompletedRequest, pop_meta: bool = True) -> None:
+            nonlocal generated_count
+            results.append(result)
+            generated_count += len(result.tokens)
+            finish_reasons[result.finish_reason] = (
+                finish_reasons.get(result.finish_reason, 0) + 1
+            )
+            if pop_meta:
+                # the uid is terminal: its cross-delivery bookkeeping is
+                # dead weight from here on (a long-lived live loop would
+                # otherwise leak one _ReqMeta per request forever).
+                # pop_meta=False is the duplicate-uid rejection, whose
+                # result must NOT tear down the original copy's live entry
+                meta.pop(result.uid, None)
+                # a cancel that raced this completion is spent — without
+                # the discard a long-lived worker leaks one entry per
+                # raced cancel AND pays the sweep's wall-clock read every
+                # step forever
+                self._cancelled.discard(result.uid)
+            if on_complete is not None:
+                on_complete(result)
+
         def complete(
             slot: int, st: _SlotState, reason: str,
             error: Optional[str] = None,
         ) -> None:
             nonlocal error_count
             now = time.perf_counter()
-            results.append(
+            m = meta[st.req.uid]
+            finish(
                 CompletedRequest(
                     uid=st.req.uid,
-                    prompt_len=len(st.req.prompt),
-                    tokens=list(st.generated),
+                    # a requeued delivery's prompt embeds earlier tokens;
+                    # the caller-visible result restores the original
+                    # prompt/output split and first-delivery latency
+                    prompt_len=m.orig_prompt_len,
+                    tokens=m.preserved + list(st.generated),
                     finish_reason=reason,
-                    ttft_s=st.ttft_s,
-                    total_s=round(now - t_start, 6),
+                    ttft_s=m.ttft_s if m.ttft_s is not None else st.ttft_s,
+                    # arrival-based, not run-start-based: in live mode the
+                    # loop may be hours old when this request arrived
+                    total_s=round(now - m.arrival, 6),
                     error=error,
-                    queue_wait_s=st.queue_wait_s,
+                    queue_wait_s=(
+                        m.queue_wait_s
+                        if m.queue_wait_s is not None
+                        else st.queue_wait_s
+                    ),
                 )
             )
-            finish_reasons[reason] = finish_reasons.get(reason, 0) + 1
             if reason == "error":
                 error_count += 1
             trace.event(
                 "serve/request_complete", uid=st.req.uid, reason=reason,
-                tokens=len(st.generated), ttft_s=st.ttft_s,
+                tokens=len(m.preserved) + len(st.generated), ttft_s=st.ttft_s,
             )
             del active[slot]
             release(slot)  # paged: pages back to the pool
@@ -295,6 +467,7 @@ class ContinuousBatchingScheduler:
         def fail_request(
             req: Request, exc: Optional[BaseException],
             queue_wait: float = 0.0, reason: str = "error",
+            error: Optional[str] = None,
         ) -> None:
             """Per-request fault isolation: record the failure, keep serving.
 
@@ -302,217 +475,557 @@ class ContinuousBatchingScheduler:
             remaining traffic is unaffected.
             """
             nonlocal error_count
-            results.append(
+            m = meta.get(req.uid)
+            finish(
                 CompletedRequest(
                     uid=req.uid,
-                    prompt_len=len(req.prompt),
-                    tokens=[],
+                    prompt_len=(
+                        m.orig_prompt_len if m is not None else len(req.prompt)
+                    ),
+                    # "preempted" promises NO tokens (the control plane
+                    # resubmits the whole request; a partial stream here
+                    # would be replayed as duplicates) — even when a
+                    # decode-exception requeue preserved some before the
+                    # drain caught the retry queued
+                    tokens=(
+                        list(m.preserved)
+                        if m is not None and reason != "preempted"
+                        else []
+                    ),
                     finish_reason=reason,
-                    ttft_s=0.0,
-                    total_s=round(time.perf_counter() - t_start, 6),
+                    ttft_s=(
+                        m.ttft_s if m is not None and m.ttft_s is not None
+                        else 0.0
+                    ),
+                    total_s=round(
+                        time.perf_counter()
+                        - (m.arrival if m is not None else t_start),
+                        6,
+                    ),
                     error=(
-                        f"{type(exc).__name__}: {exc}"
+                        error if error is not None
+                        else f"{type(exc).__name__}: {exc}"
                         if exc is not None
                         else None
                     ),
                     queue_wait_s=queue_wait,
                 )
             )
-            finish_reasons[reason] = finish_reasons.get(reason, 0) + 1
             if reason == "error":
                 error_count += 1
             trace.event(
                 "serve/request_failed", uid=req.uid, reason=reason,
             )
 
+        def activate(
+            slot: int, req: Request, budget: int, first: int,
+            queue_wait: float,
+        ) -> None:
+            """First token landed for a freshly-prefilled request (dense
+            one-shot or final chunk — ONE implementation so the two paths
+            cannot drift): build the slot state, record first-delivery
+            latency against the request's ARRIVAL clock, stream the
+            token, and complete immediately on EOS-out-of-prefill."""
+            m = meta[req.uid]
+            st = _SlotState(
+                req=req,
+                budget=budget,
+                generated=[first],
+                next_pos=len(req.prompt),
+                ttft_s=round(time.perf_counter() - m.arrival, 6),
+                queue_wait_s=queue_wait,
+                deadline_at=m.deadline_at,
+            )
+            if m.ttft_s is None:
+                m.ttft_s = st.ttft_s
+                m.queue_wait_s = queue_wait
+            if on_token is not None:
+                on_token(req.uid, first)
+            active[slot] = st
+            reason = self._finished(st)
+            if reason is not None:  # EOS straight out of prefill
+                complete(slot, st, reason)
+
+        n_requests = 0
+
+        def intake(req: Request) -> bool:
+            """Admit a request into the queue-side bookkeeping; admission
+            validation lives HERE so a malformed prompt finishes "error"
+            with a clear message instead of raising out of the loop."""
+            nonlocal n_requests, prompt_tokens
+            now = time.perf_counter()
+            if req.uid in meta:
+                # meta holds exactly the in-flight uids (entries are
+                # popped on finish): a second copy would overwrite the
+                # first's bookkeeping and the survivor would KeyError at
+                # admission after the first finishes — reject it instead
+                # of corrupting the original
+                nonlocal error_count
+                error_count += 1
+                finish(CompletedRequest(
+                    uid=req.uid,
+                    prompt_len=len(req.prompt),
+                    tokens=[],
+                    finish_reason="error",
+                    ttft_s=0.0,
+                    total_s=0.0,
+                    error="duplicate uid while the first copy is still "
+                    "in flight — rejected at admission",
+                ), pop_meta=False)
+                return False
+            deadline_s = (
+                req.deadline_s
+                if req.deadline_s is not None
+                else self.request_deadline_s
+            )
+            n_requests += 1
+            prompt_tokens += len(req.prompt)
+            meta[req.uid] = _ReqMeta(
+                arrival=now,
+                orig_prompt_len=len(req.prompt),
+                deadline_at=(
+                    now + deadline_s if deadline_s is not None else None
+                ),
+            )
+            # explicit None-check: a falsy 0 must not silently inherit the
+            # scheduler default.  Rejected per-request ("error"), never
+            # raised: in live/fleet mode a raise out of run() would kill
+            # the whole worker over one malformed client request.
+            if req.max_new_tokens is not None and req.max_new_tokens < 1:
+                fail_request(
+                    req, None,
+                    error=(
+                        f"max_new_tokens must be >= 1, got "
+                        f"{req.max_new_tokens} — rejected at admission"
+                    ),
+                )
+                return False
+            if not req.prompt:
+                fail_request(
+                    req, None,
+                    error="empty prompt rejected at admission",
+                )
+                return False
+            max_seq = getattr(engine, "max_seq", None)
+            if max_seq is not None and len(req.prompt) >= max_seq:
+                fail_request(
+                    req, None,
+                    error=(
+                        f"prompt length {len(req.prompt)} leaves no room "
+                        f"to generate (engine max_seq {max_seq}) — "
+                        "rejected at admission"
+                    ),
+                )
+                return False
+            if plan and plan.maybe_reject_admit():
+                # injected overload shedding: a "shed" result tells the
+                # router this request is safe to retry elsewhere.  Rolled
+                # ONCE here at intake — rolling in the admission loop
+                # would re-draw for the same head-of-line request on
+                # every iteration it sits blocked on page backpressure,
+                # compounding @p= and burning @N opportunity counts
+                fail_request(
+                    req, None, reason="shed",
+                    error="admission rejected (injected overload)",
+                )
+                return False
+            pending.append(req)
+            return True
+
+        def requeue_active(slot: int, st: _SlotState, why: str) -> None:
+            """Decode blew up under this slot through no fault of its own:
+            give it ONE more life.  The retry request's prompt is the
+            original prompt plus everything generated so far, so a greedy
+            retry continues bit-identically (decode is pinned bit-exact
+            against the full forward)."""
+            nonlocal decode_retries
+            m = meta[st.req.uid]
+            if m.decode_retries >= 1:
+                complete(
+                    slot, st, "error",
+                    error=f"decode failed twice ({why}); retry budget spent",
+                )
+                return
+            m.decode_retries += 1
+            decode_retries += 1
+            if m.ttft_s is None and st.generated:
+                m.ttft_s = st.ttft_s
+                m.queue_wait_s = st.queue_wait_s
+            m.preserved = m.preserved + list(st.generated)
+            retry = Request(
+                uid=st.req.uid,
+                prompt=list(st.req.prompt) + list(st.generated),
+                max_new_tokens=st.budget - len(st.generated),
+            )
+            del active[slot]
+            release(slot)
+            free.append(slot)
+            pending.appendleft(retry)
+            trace.event(
+                "serve/request_requeued", uid=st.req.uid, reason=why,
+                preserved_tokens=len(m.preserved),
+            )
+
+        pending: deque = deque()
+        for req in requests:
+            intake(req)
+
+        watchdog = None
+        if self.watchdog_deadline_s is not None:
+            from distributeddeeplearning_tpu.train.resilience import (
+                StepWatchdog,
+            )
+
+            watchdog = StepWatchdog(
+                self.watchdog_deadline_s,
+                on_timeout=self.watchdog_on_timeout,
+            ).start()
+
         capped = False
-        while pending or active or prefilling:
-            # Admit prompts into free slots — mid-flight: slots released in
-            # the previous iteration take new work while the rest decode on.
-            # Paged engines additionally gate on free PAGES: a request that
-            # could strand mid-decode is left queued (backpressure) until
-            # completions free its reservation.
-            while pending and free:
-                req = pending[0]
-                budget = budget_of(req)
-                if chunked:
-                    if not engine.fits(len(req.prompt), budget):
-                        # exceeds the POOL — waiting can never admit it
+        draining = False
+        # live mode: with a poll source the loop stays alive while idle
+        # until the source closes (poll() -> None) or a drain begins
+        more = poll is not None
+        # deadline/cancel sweeps cost one wall-clock read per loop only
+        # when something can actually expire
+        try:
+            while pending or active or prefilling or more:
+                # loop liveness for the watchdog: a tick here means the host
+                # loop is advancing — a hung decode dispatch stops ticking.
+                # NOT armed until the first decode step has completed: the
+                # first iteration contains the prefill+decode jit compiles,
+                # which have nothing to do with the steady-state deadline
+                # (same contract as the trainer, whose watchdog arms after
+                # each epoch's first step)
+                if watchdog is not None and n_decode_steps > 0:
+                    watchdog.tick(n_decode_steps)
+                if more and not draining:
+                    fresh = poll()
+                    if fresh is None:
+                        more = False  # source closed: finish what we hold
+                    else:
+                        for req in fresh:
+                            intake(req)
+                if (
+                    not draining
+                    and should_drain is not None
+                    and should_drain()
+                ):
+                    # graceful drain (SIGTERM): stop admitting, return queued
+                    # work as "preempted" for the control plane's resubmit
+                    # path, finish the requests already decoding
+                    draining = True
+                    # final inbox sweep BEFORE closing the source: a
+                    # request delivered between our last poll and the
+                    # drain signal must be reported "preempted" (its
+                    # sender is owed a terminal state), not stranded
+                    # unread in the inbox — a fleet router would
+                    # misclassify the stranded uid as a replica death
+                    if more:
+                        fresh = poll()
+                        for req in fresh or []:
+                            intake(req)
+                    more = False
+                    trace.event(
+                        "serve/drain_begin", cat="serve",
+                        pending=len(pending), active=len(active),
+                        prefilling=len(prefilling),
+                    )
+                    while prefilling:
+                        task, req, budget, queue_wait = prefilling.popleft()
+                        release(task.slot)
+                        free.append(task.slot)
+                        fail_request(req, None, queue_wait, reason="preempted")
+                if draining and pending:
+                    # NOT one-shot: a decode exception mid-drain requeues
+                    # its surviving slots here, and with admission gated
+                    # off nothing else would ever consume them (the loop
+                    # would spin forever on `pending` never emptying)
+                    while pending:
+                        fail_request(pending.popleft(), None, reason="preempted")
+
+                # deadline / cancellation sweep over in-flight work (queued
+                # requests are checked at their admission attempt below)
+                if self._cancelled or any(
+                    st.deadline_at is not None for st in active.values()
+                ):
+                    now = time.perf_counter()
+                    for slot, st in list(active.items()):
+                        if st.req.uid in self._cancelled:
+                            self._cancelled.discard(st.req.uid)
+                            complete(slot, st, "cancelled")
+                        elif (
+                            st.deadline_at is not None and now > st.deadline_at
+                        ):
+                            # partial tokens kept; the slot frees through the
+                            # normal release path (shared prefix pages keep
+                            # their refcounts — freeing mid-decode is the same
+                            # release a finished request takes)
+                            complete(slot, st, "deadline")
+
+                # Admit prompts into free slots — mid-flight: slots released in
+                # the previous iteration take new work while the rest decode on.
+                # Paged engines additionally gate on free PAGES: a request that
+                # could strand mid-decode is left queued (backpressure) until
+                # completions free its reservation.
+                while pending and not draining and free:
+                    req = pending[0]
+                    budget = budget_of(req)
+                    m = meta[req.uid]
+                    if req.uid in self._cancelled:
                         pending.popleft()
-                        prompt_tokens += len(req.prompt)
-                        fail_request(req, RuntimeError(
-                            f"request needs "
-                            f"{engine.required_pages(len(req.prompt), budget)}"
-                            f" pages, pool holds {engine.num_pages}"
-                        ))
+                        self._cancelled.discard(req.uid)
+                        fail_request(req, None, reason="cancelled")
                         continue
-                    if not engine.can_admit(len(req.prompt), budget):
-                        if active or prefilling:
-                            break  # completions will free pages
-                        # nothing in flight can free pages: fail loudly
-                        # instead of spinning forever
+                    if (
+                        m.deadline_at is not None
+                        and time.perf_counter() > m.deadline_at
+                    ):
+                        # expired while queued: never admitted, no tokens
                         pending.popleft()
-                        prompt_tokens += len(req.prompt)
-                        fail_request(req, RuntimeError(
-                            "page pool exhausted with no requests in "
-                            "flight (pages leaked?)"
-                        ))
+                        fail_request(req, None, reason="deadline")
                         continue
-                pending.popleft()
-                slot = free.pop()
-                prompt_tokens += len(req.prompt)
-                queue_wait = round(time.perf_counter() - t_start, 6)
-                if chunked:
+                    if chunked:
+                        if not engine.fits(len(req.prompt), budget):
+                            # exceeds the POOL — waiting can never admit it
+                            pending.popleft()
+                            fail_request(req, RuntimeError(
+                                f"request needs "
+                                f"{engine.required_pages(len(req.prompt), budget)}"
+                                f" pages, pool holds {engine.num_pages}"
+                            ))
+                            continue
+                        if not engine.can_admit(len(req.prompt), budget):
+                            if active or prefilling:
+                                break  # completions will free pages
+                            # nothing in flight can free pages: fail loudly
+                            # instead of spinning forever
+                            pending.popleft()
+                            fail_request(req, RuntimeError(
+                                "page pool exhausted with no requests in "
+                                "flight (pages leaked?)"
+                            ))
+                            continue
+                    pending.popleft()
+                    slot = free.pop()
+                    # arrival-based: in live mode the loop may be hours
+                    # old when this request arrived
+                    queue_wait = round(time.perf_counter() - m.arrival, 6)
+                    if chunked:
+                        try:
+                            with trace.span(
+                                "serve/admit", uid=req.uid,
+                                prompt_len=len(req.prompt),
+                            ):
+                                task = engine.prefill_begin(
+                                    slot, req.prompt, budget
+                                )
+                        except Exception as exc:  # noqa: BLE001 — per-request
+                            release(slot)
+                            fail_request(req, exc, queue_wait)
+                            free.append(slot)
+                            continue
+                        prefilling.append((task, req, budget, queue_wait))
+                        continue
                     try:
                         with trace.span(
-                            "serve/admit", uid=req.uid,
+                            "serve/prefill", uid=req.uid,
                             prompt_len=len(req.prompt),
                         ):
-                            task = engine.prefill_begin(
-                                slot, req.prompt, budget
-                            )
-                    except Exception as exc:  # noqa: BLE001 — per-request
-                        release(slot)
+                            first = engine.prefill(slot, req.prompt)
+                    except Exception as exc:  # noqa: BLE001 — isolate per request
                         fail_request(req, exc, queue_wait)
                         free.append(slot)
                         continue
-                    prefilling.append((task, req, budget, queue_wait))
-                    continue
-                try:
-                    with trace.span(
-                        "serve/prefill", uid=req.uid,
-                        prompt_len=len(req.prompt),
-                    ):
-                        first = engine.prefill(slot, req.prompt)
-                except Exception as exc:  # noqa: BLE001 — isolate per request
-                    fail_request(req, exc, queue_wait)
-                    free.append(slot)
-                    continue
-                st = _SlotState(
-                    req=req,
-                    budget=budget,
-                    generated=[first],
-                    next_pos=len(req.prompt),
-                    ttft_s=round(time.perf_counter() - t_start, 6),
-                    queue_wait_s=queue_wait,
-                )
-                active[slot] = st
-                reason = self._finished(st)
-                if reason is not None:  # EOS straight out of prefill
-                    complete(slot, st, reason)
+                    activate(slot, req, budget, first, queue_wait)
 
-            # Advance ONE chunk of the oldest in-flight prefill, then fall
-            # through to decode — the chunked-prefill interleave: running
-            # requests stall at most one chunk's compute per step, not a
-            # whole O(P²) prompt pass.
-            if prefilling:
-                task, req, budget, queue_wait = prefilling[0]
-                try:
-                    with trace.span(
-                        "serve/prefill_chunk", uid=req.uid,
-                        offset=task.offset,
-                    ):
-                        first = engine.prefill_step(task)
-                except Exception as exc:  # noqa: BLE001 — per-request
-                    prefilling.popleft()
-                    release(task.slot)
-                    fail_request(req, exc, queue_wait)
-                    free.append(task.slot)
-                else:
-                    if first is not None:  # final chunk landed
-                        prefilling.popleft()
-                        st = _SlotState(
-                            req=req,
-                            budget=budget,
-                            generated=[first],
-                            next_pos=len(req.prompt),
-                            ttft_s=round(
-                                time.perf_counter() - t_start, 6
-                            ),
-                            queue_wait_s=queue_wait,
-                        )
-                        active[task.slot] = st
-                        reason = self._finished(st)
-                        if reason is not None:
-                            complete(task.slot, st, reason)
-
-            if not active:
-                continue
-
-            for slot, st in active.items():
-                tokens_buf[slot] = st.generated[-1]
-                pos_buf[slot] = st.next_pos
-            occupancy.append(len(active) / slots)
-            t0 = time.perf_counter()
-            try:
-                with trace.span("serve/decode_step", active=len(active)):
-                    out = engine.decode(tokens_buf, pos_buf)
-            except Exception as exc:  # noqa: BLE001
-                # The decode step is batch-wide: a raise poisons every
-                # ACTIVE slot's cache position, so those requests complete
-                # as errors — but the queue keeps draining into the freed
-                # slots instead of the whole run() dying.
-                for slot, st in list(active.items()):
-                    complete(
-                        slot, st, "error",
-                        error=f"decode failed: {type(exc).__name__}: {exc}",
+                # Advance ONE chunk of the oldest in-flight prefill, then fall
+                # through to decode — the chunked-prefill interleave: running
+                # requests stall at most one chunk's compute per step, not a
+                # whole O(P²) prompt pass.
+                if prefilling:
+                    task, req, budget, queue_wait = prefilling[0]
+                    m = meta[req.uid]
+                    expired = (
+                        m.deadline_at is not None
+                        and time.perf_counter() > m.deadline_at
                     )
-                continue
-            step_times.append(time.perf_counter() - t0)
+                    if expired or req.uid in self._cancelled:
+                        # abandon mid-prefill: nothing streamed yet, pages
+                        # released through the normal decref path
+                        self._cancelled.discard(req.uid)
+                        prefilling.popleft()
+                        release(task.slot)
+                        free.append(task.slot)
+                        fail_request(
+                            req, None, queue_wait,
+                            reason="deadline" if expired else "cancelled",
+                        )
+                    else:
+                        try:
+                            with trace.span(
+                                "serve/prefill_chunk", uid=req.uid,
+                                offset=task.offset,
+                            ):
+                                first = engine.prefill_step(task)
+                        except Exception as exc:  # noqa: BLE001 — per-request
+                            prefilling.popleft()
+                            release(task.slot)
+                            fail_request(req, exc, queue_wait)
+                            free.append(task.slot)
+                        else:
+                            if first is not None:  # final chunk landed
+                                prefilling.popleft()
+                                activate(
+                                    task.slot, req, budget, first,
+                                    queue_wait,
+                                )
 
-            for slot, st in list(active.items()):
-                st.generated.append(int(out[slot]))
-                st.next_pos += 1
-                reason = self._finished(st)
-                if reason is not None:
-                    complete(slot, st, reason)
+                if not active:
+                    if more and not pending and not prefilling:
+                        # idle live loop: nothing in flight, the source still
+                        # open — back off so the poll doesn't busy-spin
+                        time.sleep(0.001)
+                    continue
 
-            if self.step_cap is not None and len(step_times) >= self.step_cap:
-                capped = True
-                break
+                for slot, st in active.items():
+                    tokens_buf[slot] = st.generated[-1]
+                    pos_buf[slot] = st.next_pos
+                occ_sum += len(active) / slots
+                occ_n += 1
+                decode_step = n_decode_steps + 1  # 1-based, the fault clock
+                if plan:
+                    stall = plan.take_decode_stall(decode_step)
+                    if stall is not None:
+                        time.sleep(stall)  # injected hung-dispatch (watchdog)
+                    if plan.has_decode_nan(decode_step):
+                        # victim needs >= 1 decode-written position so the NaN
+                        # lands in a private (never prefix-shared) cache
+                        # region — no eligible slot leaves the fault armed
+                        victim = min(
+                            (
+                                s for s, st in active.items()
+                                if st.next_pos > len(st.req.prompt)
+                            ),
+                            default=None,
+                        )
+                        if victim is not None and plan.take_decode_nan(
+                            decode_step
+                        ):
+                            poison = getattr(engine, "poison_slot", None)
+                            if poison is None:
+                                raise ValueError(
+                                    "decode_nan fault fired but the engine "
+                                    "has no poison_slot hook — the fault "
+                                    "would be a silent no-op"
+                                )
+                            poison(victim, active[victim].next_pos - 1)
+                t0 = time.perf_counter()
+                try:
+                    with trace.span("serve/decode_step", active=len(active)):
+                        out = engine.decode(tokens_buf, pos_buf)
+                except Exception as exc:  # noqa: BLE001
+                    # The decode step failed batch-wide through no fault of
+                    # any single request (a hung collective, a dispatch bug):
+                    # requeue every active slot ONCE — prompt extended by the
+                    # tokens already generated, so a greedy retry continues
+                    # bit-identically — instead of failing them all.  A slot
+                    # whose retry budget is spent completes "error".
+                    for slot, st in list(active.items()):
+                        requeue_active(
+                            slot, st,
+                            f"decode failed: {type(exc).__name__}: {exc}",
+                        )
+                    continue
+                step_hist.record(time.perf_counter() - t0)  # host math only
+                n_decode_steps += 1
 
-        if capped:
-            # deadline semantics for smoke runs: everything still running
-            # or queued is accounted for, nothing hangs
-            for slot, st in list(active.items()):
-                complete(slot, st, "step_cap")
-            while prefilling:
-                task, req, budget, queue_wait = prefilling.popleft()
-                release(task.slot)
-                free.append(task.slot)
-                fail_request(req, None, queue_wait, reason="cancelled")
-            while pending:
-                req = pending.popleft()
-                prompt_tokens += len(req.prompt)
-                fail_request(req, None, reason="cancelled")
+                # NaN quarantine: engines report per-slot logit finiteness
+                # from the SAME jitted step (no extra sync).  A poisoned slot
+                # is scrubbed and fails alone — the batch decodes on.
+                finite = getattr(engine, "last_finite", None)
+                for slot, st in list(active.items()):
+                    if finite is not None and not finite[slot]:
+                        quarantined += 1
+                        scrub = getattr(engine, "scrub_slot", None)
+                        if scrub is not None:
+                            # zero the slot's decode-written region so the
+                            # NaN cannot leak to the next occupant via the
+                            # 0-weight * NaN-value softmax path
+                            scrub(slot, len(st.req.prompt))
+                        trace.event(
+                            "serve/request_quarantined", uid=st.req.uid,
+                            step=decode_step,
+                        )
+                        complete(
+                            slot, st, "error",
+                            error="non-finite logits (quarantined at decode "
+                            f"step {decode_step})",
+                        )
+                        continue
+                    tok = int(out[slot])
+                    st.generated.append(tok)
+                    st.next_pos += 1
+                    if on_token is not None:
+                        on_token(st.req.uid, tok)
+                    reason = self._finished(st)
+                    if reason is not None:
+                        complete(slot, st, reason)
+
+                if on_step is not None:
+                    on_step(decode_step)
+
+                if self.step_cap is not None and n_decode_steps >= self.step_cap:
+                    capped = True
+                    break
+
+            if capped:
+                # deadline semantics for smoke runs: everything still running
+                # or queued is accounted for, nothing hangs
+                for slot, st in list(active.items()):
+                    complete(slot, st, "step_cap")
+                while prefilling:
+                    task, req, budget, queue_wait = prefilling.popleft()
+                    release(task.slot)
+                    free.append(task.slot)
+                    fail_request(req, None, queue_wait, reason="cancelled")
+                while pending:
+                    fail_request(pending.popleft(), None, reason="cancelled")
+        finally:
+            # the watchdog must die with the loop: a lingering armed
+            # watchdog would hard-exit the process long after run()
+            # returned (or raised)
+            if watchdog is not None:
+                watchdog.stop()
 
         wall = time.perf_counter() - t_start
-        generated = sum(len(r.tokens) for r in results)
+        generated = generated_count
         # steady-state streaming latency per request: the inter-token gap
         # after the first token landed (only measurable past 2 tokens)
         tpot = [
             (r.total_s - r.ttft_s) / (len(r.tokens) - 1)
             for r in results
-            if len(r.tokens) >= 2 and r.finish_reason != "cancelled"
+            if len(r.tokens) >= 2
+            and r.finish_reason not in ("cancelled", "preempted")
         ]
         report = ServeReport(
             requests=n_requests,
             batch_slots=slots,
             generated_tokens=generated,
             prompt_tokens=prompt_tokens,
-            decode_steps=len(step_times),
+            decode_steps=n_decode_steps,
             wall_s=round(wall, 4),
             tokens_per_sec=round(generated / wall, 2) if wall > 0 else 0.0,
             ttft_s=_percentiles([r.ttft_s for r in results]),
-            decode_step_s=_percentiles(step_times),
+            decode_step_s=step_hist.summary(),
             slot_occupancy_mean=(
-                round(float(np.mean(occupancy)), 4) if occupancy else 0.0
+                round(occ_sum / occ_n, 4) if occ_n else 0.0
             ),
             finish_reasons=finish_reasons,
             errors=error_count,
             queue_wait_s=_percentiles(
                 [r.queue_wait_s for r in results if r.finish_reason
-                 not in ("cancelled",)]
+                 not in ("cancelled", "preempted", "shed", "deadline")]
             ),
             tpot_s=_percentiles(tpot),
             prefill_compiles=(
@@ -534,6 +1047,9 @@ class ContinuousBatchingScheduler:
                 if hasattr(engine, "kv_bytes_peak")
                 else 0
             ),
+            decode_retries=decode_retries,
+            quarantined=quarantined,
+            drained=draining,
         )
         # end-of-run rollup into the process metrics registry (one
         # record_many per stream, NOT per step — the hot loop stays hot):
@@ -542,6 +1058,8 @@ class ContinuousBatchingScheduler:
         reg.counter("serve.requests").inc(n_requests)
         reg.counter("serve.generated_tokens").inc(generated)
         reg.counter("serve.errors").inc(error_count)
+        reg.counter("serve.decode_retries").inc(decode_retries)
+        reg.counter("serve.quarantined").inc(quarantined)
         # cancelled/errored/step_cap-cut requests never produced a first
         # token and carry a hardcoded ttft_s=0.0 — recording them would
         # drag the cross-run histogram toward 0 on every smoke or fault
@@ -550,9 +1068,9 @@ class ContinuousBatchingScheduler:
             [r.ttft_s for r in results if r.tokens]
         )
         reg.histogram("serve.tpot_s").record_many(tpot)
-        reg.histogram("serve.decode_step_s").record_many(step_times)
+        reg.histogram("serve.decode_step_s").merge(step_hist)
         reg.gauge("serve.tokens_per_sec").set(report.tokens_per_sec)
         reg.gauge("serve.slot_occupancy_mean").set(
             report.slot_occupancy_mean
         )
-        return results, report
+        return list(results), report

@@ -34,6 +34,32 @@ Kinds:
                  the **Nth storage opportunity** (storage sites have no
                  train-step context), NOT at true step N.
 
+Serve-side kinds (PR 7 — consumed by ``serve/scheduler`` and the fleet
+supervisor in ``serve/fleet``; their ``@N`` is the scheduler's **decode
+step** counter, 1-based, per worker process):
+
+- ``replica_death`` the fleet worker hard-exits (``os._exit``) at decode
+                 step N — no drain, no goodbye; the router must detect the
+                 death, restart the replica, and requeue its in-flight
+                 requests onto survivors;
+- ``decode_nan``   one active request's K-cache history is poisoned with
+                 NaN at the first decode step >= N that has an eligible
+                 victim (a slot that has decoded at least one token, so
+                 the poison lands in a decode-written — never shared —
+                 cache region): the scheduler's quarantine must fail ONLY
+                 that request;
+- ``decode_stall`` the decode dispatch sleeps ``secs`` (default 1.0) at
+                 the first decode step >= N — scheduler-watchdog fodder;
+- ``reject_admit`` admission rejects the request with probability ``p``
+                 (or once at the Nth admission opportunity) — the
+                 overload-shedding path; the request finishes ``"shed"``
+                 and the fleet router redelivers it elsewhere.
+
+The serve step-keyed kinds use **at-or-after** matching (first decode
+step ``>= N``): decode steps are contiguous per worker, but ``decode_nan``
+must wait for an eligible victim, and at-or-after keeps the whole family
+deterministic under that gating.
+
 Step numbering for the train/data kinds is the framework's **true step**:
 the step whose completion sets ``state.step == N`` (the same numbering
 checkpoints use), 1-based.
@@ -57,7 +83,15 @@ logger = logging.getLogger("ddlt.faults")
 
 ENV_VAR = "DDLT_FAULTS"
 
-KINDS = ("nan_loss", "data_stall", "data_death", "preempt", "io_error")
+KINDS = (
+    "nan_loss", "data_stall", "data_death", "preempt", "io_error",
+    "replica_death", "decode_nan", "decode_stall", "reject_admit",
+)
+
+#: kinds the serving stack consumes — the fleet supervisor DEALS these
+#: across replica workers (see :func:`deal_serve_faults`) instead of
+#: letting every worker's inherited environment fire all of them
+SERVE_KINDS = ("replica_death", "decode_nan", "decode_stall", "reject_admit")
 
 
 class InjectedIOError(IOError):
@@ -179,6 +213,22 @@ class FaultPlan:
                 return spec
         return None
 
+    def _take_at_or_after(self, kind: str, step: int) -> Optional[FaultSpec]:
+        """Consume the one-shot ``kind`` fault armed for any step <= ``step``
+        (at-or-after matching — the serve decode-step kinds, see module
+        docstring)."""
+        for spec in self.specs:
+            if (
+                spec.kind == kind
+                and spec.step is not None
+                and spec.step <= step
+                and not spec.fired
+            ):
+                spec.fired = True
+                self._record(spec, step, kind)
+                return spec
+        return None
+
     def _prob_fires(self, spec: FaultSpec, site: str) -> bool:
         rng = self._rngs.setdefault(
             id(spec), random.Random(int(spec.options.get("seed", 0)))
@@ -251,6 +301,59 @@ class FaultPlan:
 
         return wrapped()
 
+    # -- hook: serve scheduler / fleet worker ----------------------------
+
+    def take_replica_death(self, step: int) -> bool:
+        """``replica_death``: True when the worker should hard-exit NOW
+        (first decode step >= the armed step)."""
+        return self._take_at_or_after("replica_death", step) is not None
+
+    def take_decode_stall(self, step: int) -> Optional[float]:
+        """``decode_stall``: seconds to sleep before this decode step's
+        dispatch, or None."""
+        spec = self._take_at_or_after("decode_stall", step)
+        if spec is None:
+            return None
+        return float(spec.options.get("secs", 1.0))
+
+    def has_decode_nan(self, step: int) -> bool:
+        """Non-consuming peek: a ``decode_nan`` is armed for step <= N.
+
+        The scheduler peeks first because the fault needs an eligible
+        victim (a slot with at least one decode-written position — see
+        module docstring); with none active the fault stays armed for the
+        next step instead of being burned on a no-op."""
+        return any(
+            s.kind == "decode_nan"
+            and s.step is not None
+            and s.step <= step
+            and not s.fired
+            for s in self.specs
+        )
+
+    def take_decode_nan(self, step: int) -> bool:
+        """Consume the armed ``decode_nan`` (call only with a victim)."""
+        return self._take_at_or_after("decode_nan", step) is not None
+
+    def maybe_reject_admit(self) -> bool:
+        """``reject_admit``: True when THIS admission opportunity must be
+        rejected (probabilistic ``@p=`` — seeded — or one-shot at the Nth
+        admission opportunity for the ``@N`` form)."""
+        for spec in self.specs:
+            if spec.kind != "reject_admit":
+                continue
+            if spec.prob is not None:
+                if self._prob_fires(spec, "reject_admit"):
+                    return True
+            elif not spec.fired:
+                n = self._io_opportunities.get(id(spec), 0) + 1
+                self._io_opportunities[id(spec)] = n
+                if n >= (spec.step or 1):
+                    spec.fired = True
+                    self._record(spec, spec.step, "reject_admit")
+                    return True
+        return False
+
     # -- hook: storage paths ---------------------------------------------
 
     def maybe_io_error(self, site: str) -> None:
@@ -284,6 +387,46 @@ class FaultPlan:
         ]
 
 
+# -- fleet helpers: dealing a spec across replica workers -----------------
+
+
+def deal_serve_faults(text: str, n_replicas: int) -> List[str]:
+    """Split a ``DDLT_FAULTS`` spec into one per-replica spec string.
+
+    Serve-side entries (:data:`SERVE_KINDS`) go to exactly ONE replica —
+    an explicit ``:replica=k`` option wins, otherwise serve entries are
+    dealt round-robin in spec order — because every spawned worker
+    re-parses its environment: without dealing, ``replica_death@3`` would
+    kill EVERY replica at its own step 3 and leave no survivor to requeue
+    onto.  Non-serve entries (``io_error`` etc.) replicate to all workers.
+    """
+    if n_replicas < 1:
+        raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+    dealt: List[List[str]] = [[] for _ in range(n_replicas)]
+    serve_i = 0
+    for spec in parse_spec(text or ""):
+        if spec.kind in SERVE_KINDS:
+            if "replica" in spec.options:
+                target = int(spec.options["replica"]) % n_replicas
+            else:
+                target = serve_i % n_replicas
+                serve_i += 1
+            dealt[target].append(spec.describe())
+        else:
+            for entries in dealt:
+                entries.append(spec.describe())
+    return [",".join(entries) for entries in dealt]
+
+
+def strip_kinds(text: str, kinds) -> str:
+    """Drop every entry of the given kinds from a spec string — the fleet
+    supervisor strips ``replica_death`` from a RESTARTED replica's spec so
+    an injected death is not replayed forever (the restarted process would
+    otherwise re-parse the same spec and die at its own step N again)."""
+    kept = [s.describe() for s in parse_spec(text or "") if s.kind not in kinds]
+    return ",".join(kept)
+
+
 # -- process-level plan (one-shot across in-process restarts) ------------
 
 _PLAN: Optional[FaultPlan] = None
@@ -307,3 +450,19 @@ def reset() -> FaultPlan:
     global _PLAN
     _PLAN = None
     return get_plan()
+
+
+def install_plan(text: str) -> FaultPlan:
+    """Install an explicit spec as THE process plan, ignoring the
+    environment — fleet workers use this so the per-replica spec their
+    supervisor dealt them overrides the full ``DDLT_FAULTS`` they
+    inherited at spawn (which would otherwise fire every entry in every
+    worker)."""
+    global _PLAN
+    _PLAN = FaultPlan(parse_spec(text or ""))
+    if _PLAN:
+        logger.warning(
+            "fault injection ACTIVE (installed): %s",
+            ", ".join(s.describe() for s in _PLAN.specs),
+        )
+    return _PLAN

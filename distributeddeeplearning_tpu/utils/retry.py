@@ -17,6 +17,13 @@ policy, used by every caller that talks to the outside world
 
 ``sleep``/``rng`` are injectable so tests assert the bound without
 sleeping.
+
+Every retry and every give-up is ALSO counted in the process metrics
+registry (``retry.attempts.<label>`` / ``retry.giveups.<label>``, label =
+the call's ``description`` with spaces collapsed), so chaos benches and
+``ddlt obs`` snapshots can report *retry pressure* — how hard the I/O
+layer worked to keep a run alive — per call site, not just whether the
+run survived.
 """
 
 from __future__ import annotations
@@ -27,6 +34,21 @@ import time
 from typing import Callable, Optional, Sequence, Tuple, Type
 
 logger = logging.getLogger("ddlt.retry")
+
+
+def _counter_label(fn: Callable, description: str) -> str:
+    """Call-site label for the registry counters: the human description
+    (spaces -> ``_``) or the function name."""
+    label = description or getattr(fn, "__name__", "operation")
+    return "_".join(label.split())
+
+
+def _count(kind: str, label: str) -> None:
+    # lazy import: obs.registry's snapshot path itself writes through
+    # retry_call, so a top-level import here would be circular
+    from distributeddeeplearning_tpu.obs.registry import get_registry
+
+    get_registry().counter(f"retry.{kind}.{label}").inc()
 
 
 def backoff_delays(
@@ -71,15 +93,21 @@ def retry_call(
     delays = backoff_delays(
         retries, base_delay=base_delay, max_delay=max_delay, rng=rng
     )
+    label = _counter_label(fn, description)
     attempt = 0
     while True:
         try:
             return fn(*args, **kwargs)
         except retry_on as exc:
             if attempt >= retries:
+                # exhausted: the caller sees the exception; the counter is
+                # how a chaos bench sees it (RateLimitedLogger may have
+                # suppressed the log line)
+                _count("giveups", label)
                 raise
             delay = next(delays)
             attempt += 1
+            _count("attempts", label)
             logger.warning(
                 "%s failed (%s); retry %d/%d in %.2fs",
                 description or getattr(fn, "__name__", "operation"),

@@ -118,6 +118,59 @@ def test_serve_decode_loop_has_no_unmarked_host_sync():
     )
 
 
+def _fleet_dispatch_loop_body():
+    """Source lines of the fleet router's dispatch loop inside
+    ``FleetRouter.serve`` (by indentation, comments included) — the
+    cross-process serving hot loop: queue pumps, health checks and
+    least-loaded dispatch between the workers' decode steps."""
+    from distributeddeeplearning_tpu.serve.fleet import FleetRouter
+
+    lines = inspect.getsource(FleetRouter.serve).splitlines()
+    start = next(
+        i for i, line in enumerate(lines)
+        if "while len(results) < len(flights)" in line
+    )
+    indent = len(lines[start]) - len(lines[start].lstrip())
+    body = []
+    for line in lines[start + 1:]:
+        if line.strip() and (len(line) - len(line.lstrip())) <= indent:
+            break
+        body.append(line)
+    assert body, "could not locate the fleet dispatch loop body"
+    return body
+
+
+def test_fleet_dispatch_loop_has_no_unmarked_host_sync():
+    """The router is host bookkeeping by design — its ONE blocking call
+    is the outbox get with a short timeout (the idle wait on worker
+    messages, not a device sync).  Any device-value token (``float(``/
+    ``.item()``/``np.asarray``/``device_get``) appearing in the dispatch
+    loop means engine state leaked across the process boundary into the
+    router's per-iteration path; that must carry a ``# sync-ok`` marker
+    with its justification or move into the workers."""
+    body = _fleet_dispatch_loop_body()
+    # right-region guard: the loop we grep must be the one that pumps the
+    # outbox and supervises replica health
+    assert any("self._outbox.get" in line for line in body), (
+        "fleet lint is not scanning the dispatch loop"
+    )
+    assert any("handle_death" in line for line in body), (
+        "fleet lint is not scanning the supervision path"
+    )
+    offenders = [
+        line.strip()
+        for line in body
+        if BANNED.search(line) and MARKER not in line
+    ]
+    assert not offenders, (
+        "host-sync token in the fleet router's dispatch loop — the "
+        "router must stay pure host bookkeeping (device values never "
+        "cross the process boundary).  Move the work into the replica "
+        "workers, or tag a deliberate documented price with "
+        f"'# {MARKER}':\n  " + "\n  ".join(offenders)
+    )
+
+
 def test_step_builders_have_no_host_sync_tokens():
     from distributeddeeplearning_tpu.train import step as step_mod
 

@@ -565,7 +565,12 @@ def test_scheduler_isolates_per_request_prefill_failure():
     assert report.to_dict()["errors"] == 1  # surfaced in the artifact schema
 
 
-def test_scheduler_survives_decode_failure_and_drains_queue():
+def test_scheduler_requeues_surviving_slots_on_decode_failure():
+    """PR 7 semantics: an exception out of ``engine.decode`` itself is not
+    any request's fault — the active slots are requeued ONCE (tokens
+    already generated preserved, budget reduced) instead of all finishing
+    "error", and the queue keeps draining."""
+
     class _FlakyDecode(_FakeEngine):
         def __init__(self):
             self.calls = 0
@@ -588,9 +593,43 @@ def test_scheduler_survives_decode_failure_and_drains_queue():
     results, report = sched.run(
         [Request("x", [1]), Request("y", [2]), Request("z", [3])]
     )
-    reasons = {r.uid: r.finish_reason for r in results}
-    assert report.errors == 2          # the two slots active at the failure
-    assert reasons["z"] == "length"    # queued request still served
+    by_uid = {r.uid: r for r in results}
+    # the two slots active at the failure survived via requeue
+    assert report.errors == 0
+    assert report.decode_retries == 2
+    assert {r.finish_reason for r in results} == {"length"}
+    for uid in ("x", "y"):
+        # prefill's token was preserved across the requeue and the final
+        # result restores the original prompt/output split
+        assert by_uid[uid].tokens[0] == 1
+        assert len(by_uid[uid].tokens) == 2
+        assert by_uid[uid].prompt_len == 1
+    assert len(by_uid["z"].tokens) == 2  # queued request still served
+
+
+def test_scheduler_decode_failure_retry_budget_is_bounded():
+    """A decode that fails every time must not requeue forever: the
+    second failure under the same request completes it "error"."""
+
+    class _DeadDecode(_FakeEngine):
+        def prefill(self, slot, prompt):
+            return 1
+
+        def decode(self, tokens, pos):
+            raise RuntimeError("collective very dead")
+
+    from distributeddeeplearning_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+
+    sched = ContinuousBatchingScheduler(_DeadDecode(), max_new_tokens=3)
+    results, report = sched.run([Request("x", [1, 2])])
+    (res,) = results
+    assert res.finish_reason == "error"
+    assert "retry budget spent" in res.error
+    assert report.errors == 1
+    assert report.decode_retries == 1  # exactly one retry was granted
 
 
 # --------------------------------------------------------------------------

@@ -225,9 +225,16 @@ def test_per_request_token_budget(params):
     by_uid = {r.uid: r for r in results}
     assert len(by_uid["short"].tokens) == 2
     assert len(by_uid["default"].tokens) == 6
-    # a zero budget is rejected, not silently promoted to the default
-    with pytest.raises(ValueError, match="max_new_tokens"):
-        sched.run([Request(uid="zero", prompt=[4, 9], max_new_tokens=0)])
+    # a zero budget is rejected per-request (not silently promoted to the
+    # default, and not raised — in live/fleet mode a raise out of run()
+    # would kill the whole worker over one malformed client request)
+    results, report = sched.run(
+        [Request(uid="zero", prompt=[4, 9], max_new_tokens=0)]
+    )
+    (res,) = results
+    assert res.finish_reason == "error"
+    assert "max_new_tokens" in res.error
+    assert report.errors == 1
 
 
 def test_sharded_cache_smoke(params):
