@@ -406,6 +406,29 @@ def build_parser() -> argparse.ArgumentParser:
                          "the f32 and quantized model before serving "
                          "(--quantize-weights): prints logit MAE + greedy "
                          "agreement to stderr; 0 = quantize blind")
+    serve_p.add_argument("--speculative", action="store_true",
+                         help="speculative decoding (spec/): a cheap "
+                         "drafter proposes --draft-tokens greedy tokens "
+                         "per slot and the full model verifies all K+1 "
+                         "positions in one batched call — greedy output "
+                         "stays bit-identical to non-speculative decode. "
+                         "Greedy-only (temperature 0) and f32 KV cache "
+                         "only; single replica")
+    serve_p.add_argument("--draft-tokens", type=int, default=4,
+                         help="draft tokens K per speculative step (each "
+                         "step commits 1..K+1 tokens per slot)")
+    serve_p.add_argument("--draft-layers", type=int, default=None,
+                         help="layers of the truncated self-draft drafter "
+                         "(first M layers of the shared stack + the "
+                         "shared head; default: half the stack).  "
+                         "Ignored with --draft-weights int8")
+    serve_p.add_argument("--draft-weights", default=None,
+                         choices=("int8",),
+                         help="draft with the full-depth int8-weight "
+                         "model instead of the truncated stack (the f32 "
+                         "model still verifies, so output is unchanged); "
+                         "with --checkpoint-dir the drafter restores via "
+                         "restore_params(quantize_weights='int8')")
     serve_p.add_argument("--replicas", type=int, default=1,
                          help="engine replica WORKER PROCESSES (serve/"
                          "fleet.py): >1 runs the supervised fleet — a "
@@ -1150,6 +1173,44 @@ def _cmd_serve(args) -> int:
     """
     import json as _json
 
+    # --speculative flag-combination guards, at parse time: the
+    # acceptance rule is greedy-only (argmax comparison) and extends the
+    # decode==full-forward bit-exactness pin, which needs the f32 cache.
+    # Erroring HERE beats silently serving non-equivalent samples after
+    # a full engine build.
+    if args.speculative:
+        if args.temperature > 0:
+            print(
+                "--speculative is greedy-only for now: the acceptance "
+                "rule compares argmaxes, so temperature "
+                f"{args.temperature} would silently produce samples NOT "
+                "equivalent to non-speculative decoding.  Drop "
+                "--temperature (or set it to 0).",
+                file=sys.stderr,
+            )
+            return 1
+        if args.quantize_kv is not None:
+            print(
+                "--speculative requires the f32 KV cache: the verifier "
+                "extends the decode==full-forward bit-exactness pin, "
+                "which the int8 grid breaks.  Use --draft-weights int8 "
+                "for the int8 DRAFTER (the f32 model still verifies).",
+                file=sys.stderr,
+            )
+            return 1
+        if args.replicas > 1:
+            print(
+                "--speculative is single-replica for now (the fleet "
+                "spec does not carry drafter state)", file=sys.stderr,
+            )
+            return 1
+        if args.draft_tokens < 1:
+            print("--draft-tokens must be >= 1", file=sys.stderr)
+            return 1
+        if args.draft_layers is not None and args.draft_layers < 1:
+            print("--draft-layers must be >= 1", file=sys.stderr)
+            return 1
+
     if args.synthetic:
         prompts = None
     else:
@@ -1424,6 +1485,22 @@ def _cmd_serve(args) -> int:
             rng=jax.random.key(args.seed),
             prefix_cache=not args.no_prefix_cache,
         ), None
+    elif args.speculative:
+        # spec is single-mesh (the verify/rollback programs carry no
+        # sharding annotations) — build the dense engine unmeshed
+        from distributeddeeplearning_tpu.serve import InferenceEngine
+
+        engine, mesh = InferenceEngine(
+            params,
+            num_heads=num_heads,
+            batch_slots=args.batch_slots,
+            max_seq=max_seq,
+            prefill_attention=args.prefill_attention,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            cache_dtype=cache_dtype,
+            rng=jax.random.key(args.seed),
+        ), None
     else:
         engine, mesh = data_parallel_engine(
             params,
@@ -1436,10 +1513,54 @@ def _cmd_serve(args) -> int:
             cache_dtype=cache_dtype,
             rng=jax.random.key(args.seed),
         )
+
+    spec_decoder = None
+    if args.speculative:
+        from distributeddeeplearning_tpu.spec import (
+            Int8Drafter,
+            SpeculativeDecoder,
+        )
+
+        if args.draft_weights == "int8":
+            qdraft = None
+            if args.checkpoint_dir:
+                # the int8 drafter pytree straight from the f32
+                # checkpoint — no second full-precision copy held
+                from distributeddeeplearning_tpu.train.checkpoint import (
+                    Checkpointer,
+                )
+
+                ckpt = Checkpointer(args.checkpoint_dir)
+                try:
+                    qdraft, _ = ckpt.restore_params(
+                        quantize_weights="int8"
+                    )
+                finally:
+                    ckpt.close()
+            spec_decoder = SpeculativeDecoder(
+                engine, drafter=Int8Drafter(qdraft),
+                draft_tokens=args.draft_tokens,
+            )
+        else:
+            spec_decoder = SpeculativeDecoder(
+                engine, drafter="truncated",
+                draft_tokens=args.draft_tokens,
+                draft_layers=args.draft_layers,
+            )
+        print(
+            f"[serve] speculative: drafter={spec_decoder.drafter_name} "
+            f"draft_tokens={args.draft_tokens}"
+            + (
+                f" draft_layers={spec_decoder.draft_layers}"
+                if spec_decoder.drafter_name == "truncated" else ""
+            ),
+            file=sys.stderr,
+        )
     scheduler = ContinuousBatchingScheduler(
         engine, eos_id=args.eos_id, max_new_tokens=args.max_new_tokens,
         request_deadline_s=args.request_deadline_s,
         watchdog_deadline_s=args.watchdog_deadline_s,
+        spec_decoder=spec_decoder,
     )
     reqs = [Request(uid=uid, prompt=p) for uid, p in prompts]
     # SIGTERM -> graceful drain (stop admitting, finish active requests,

@@ -614,6 +614,187 @@ def forward_prefill_chunk(
     return _mm(x, params["head"])[None], new_cache
 
 
+def forward_verify(
+    params, tokens, cache, pos, draft_len, *, num_heads: int
+):
+    """Batched K+1-token verification step against the DENSE cache — the
+    verifier half of speculative decoding (``spec/``).
+
+    ``tokens``: [B, K1] int32 — column 0 is each slot's pending token,
+    columns 1..K its drafted continuation; ``pos``: [B] int32 — the
+    position column 0 occupies; ``draft_len``: [B] int32 in [0, K1-1] —
+    how many of the K draft columns are real for each slot (slots near
+    their budget or ``max_seq`` verify fewer; 0 degenerates to exactly a
+    single-token decode step).
+
+    Chunk-prefill-style write-then-attend (``forward_prefill_chunk``),
+    batched over slots at per-slot positions: each layer first scatters
+    the K/V of every VALID token (column ``j <= draft_len``) into the
+    cache at ``pos + j``, then attends over the slot's full cache row
+    with query ``j`` seeing positions ``<= pos + j`` — so the logits at
+    column ``j`` are computed from exactly the history a sequential
+    ``forward_decode`` walk would have seen, and the greedy argmax chain
+    is bit-identical to non-speculative decode (``tests/test_spec.py``
+    pins it position-for-position).  Invalid columns write NOWHERE
+    (their scatter indices are pushed out of bounds and dropped) and
+    their logits are garbage the caller must mask.
+
+    Returns ``(logits [B, K1, vocab], new_cache)``.  The caller owns the
+    rollback: positions past the accepted prefix hold rejected-draft K/V
+    that must be scrubbed (``engine.scrub_slot`` / the spec decoder's
+    batched rollback) before they could ever be exposed.
+
+    f32 cache only: the int8 layout's exact-own-token overlay is
+    per-query here, which cannot reproduce sequential decode's numerics
+    bitwise — speculative decoding gates on the f32 cache.
+    """
+    if quantized_cache(cache):
+        raise ValueError(
+            "speculative verification supports the f32 cache layout only "
+            "(the acceptance rule extends the decode==full-forward "
+            "bit-exactness pin, which the int8 grid breaks)"
+        )
+    b, K1 = tokens.shape
+    S = cache["k"].shape[2]
+    posmat = pos[:, None] + jnp.arange(K1)[None]  # [B, K1]
+    valid = jnp.arange(K1)[None] <= draft_len[:, None]
+    max_len = params["pos"].shape[0]
+    x = (
+        params["embed"][tokens]
+        + params["pos"][jnp.minimum(posmat, max_len - 1)]
+    )  # [B, K1, d]
+    d = x.shape[-1]
+    hd = d // num_heads
+    # invalid columns scatter out of bounds -> dropped (never clamped:
+    # a clamped write could collide with a valid column's position)
+    wpos = jnp.where(valid, posmat, S)
+    rows = jnp.arange(b)[:, None]
+
+    def body(carry, xs):
+        p, k_l, v_l = xs
+        h = _layer_norm(carry, p["ln1"])
+        qkv = _mm(h, p["qkv"])  # [B, K1, 3d]
+        q, k_c, v_c = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, K1, num_heads, hd)
+        k_c = k_c.reshape(b, K1, num_heads, hd)
+        v_c = v_c.reshape(b, K1, num_heads, hd)
+        k_l = k_l.at[rows, wpos].set(k_c.astype(k_l.dtype), mode="drop")
+        v_l = v_l.at[rows, wpos].set(v_c.astype(v_l.dtype), mode="drop")
+        scores = jnp.einsum("bqhd,bshd->bqhs", q, k_l) / jnp.sqrt(
+            jnp.asarray(hd, jnp.float32)
+        )
+        visible = jnp.arange(S)[None, None, :] <= posmat[:, :, None]
+        scores = jnp.where(visible[:, :, None, :], scores, -1e30)
+        attn = jax.nn.softmax(scores, axis=-1).astype(v_l.dtype)
+        ctx = jnp.einsum("bqhs,bshd->bqhd", attn, v_l).reshape(
+            b, K1, d
+        ).astype(carry.dtype)
+        out = carry + _mm(ctx, p["proj"])
+        h = _layer_norm(out, p["ln2"])
+        out = out + _mm(
+            jax.nn.gelu(_mm(h, p["w_in"]), approximate=False), p["w_out"]
+        )
+        return out, (k_l, v_l)
+
+    xs = (
+        params["blocks"],
+        jnp.moveaxis(cache["k"], 1, 0),
+        jnp.moveaxis(cache["v"], 1, 0),
+    )
+    x, (k_new, v_new) = jax.lax.scan(body, x, xs)
+    new_cache = {
+        "k": jnp.moveaxis(k_new, 0, 1),
+        "v": jnp.moveaxis(v_new, 0, 1),
+    }
+    return _mm(x, params["head"]), new_cache
+
+
+def forward_verify_paged(
+    params, tokens, cache, pos, draft_len, block_tables, *,
+    num_heads: int, page_size: int,
+):
+    """Batched K+1-token verification step over the PAGED cache layout.
+
+    Same contract as :func:`forward_verify` (``tokens`` [B, K1], per-slot
+    ``pos``/``draft_len``, returns ``(logits [B, K1, vocab], new_cache)``)
+    with the key space routed through the page pool: valid columns
+    scatter to ``(table[(pos+j) // page_size], (pos+j) % page_size)``,
+    invalid or out-of-table columns land in the scratch page (the
+    dustbin — same convention as decode's released-slot lanes), and
+    attention runs over the block-table-gathered page view masked to
+    ``<= pos + j`` per query.  Bit-identical to the dense verify (the
+    gathered view IS the dense key sequence) and therefore to sequential
+    paged decode.  f32 pool only, like the dense verify.
+    """
+    if quantized_cache(cache):
+        raise ValueError(
+            "speculative verification supports the f32 cache layout only "
+            "(the acceptance rule extends the decode==full-forward "
+            "bit-exactness pin, which the int8 grid breaks)"
+        )
+    b, K1 = tokens.shape
+    nb = block_tables.shape[1]
+    s = nb * page_size
+    posmat = pos[:, None] + jnp.arange(K1)[None]  # [B, K1]
+    valid = jnp.arange(K1)[None] <= draft_len[:, None]
+    max_len = params["pos"].shape[0]
+    x = (
+        params["embed"][tokens]
+        + params["pos"][jnp.minimum(posmat, max_len - 1)]
+    )  # [B, K1, d]
+    d = x.shape[-1]
+    hd = d // num_heads
+    rows = jnp.arange(b)[:, None]
+    page_idx = posmat // page_size
+    in_range = valid & (page_idx < nb)
+    # invalid/overflow columns -> scratch page 0 (the dustbin), exactly
+    # like forward_prefill_chunk's padding overflow
+    pages = jnp.where(
+        in_range, block_tables[rows, jnp.minimum(page_idx, nb - 1)], 0
+    )
+    offs = jnp.where(in_range, posmat % page_size, 0)
+
+    def body(carry, xs):
+        p, k_l, v_l = xs
+        h = _layer_norm(carry, p["ln1"])
+        qkv = _mm(h, p["qkv"])  # [B, K1, 3d]
+        q, k_c, v_c = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, K1, num_heads, hd)
+        k_c = k_c.reshape(b, K1, num_heads, hd)
+        v_c = v_c.reshape(b, K1, num_heads, hd)
+        k_l = k_l.at[pages, offs].set(k_c.astype(k_l.dtype))
+        v_l = v_l.at[pages, offs].set(v_c.astype(v_l.dtype))
+        k_seq = k_l[block_tables].reshape(b, s, num_heads, hd)
+        v_seq = v_l[block_tables].reshape(b, s, num_heads, hd)
+        scores = jnp.einsum("bqhd,bshd->bqhs", q, k_seq) / jnp.sqrt(
+            jnp.asarray(hd, jnp.float32)
+        )
+        visible = jnp.arange(s)[None, None, :] <= posmat[:, :, None]
+        scores = jnp.where(visible[:, :, None, :], scores, -1e30)
+        attn = jax.nn.softmax(scores, axis=-1).astype(v_seq.dtype)
+        ctx = jnp.einsum("bqhs,bshd->bqhd", attn, v_seq).reshape(
+            b, K1, d
+        ).astype(carry.dtype)
+        out = carry + _mm(ctx, p["proj"])
+        h = _layer_norm(out, p["ln2"])
+        out = out + _mm(
+            jax.nn.gelu(_mm(h, p["w_in"]), approximate=False), p["w_out"]
+        )
+        return out, (k_l, v_l)
+
+    xs = (
+        params["blocks"],
+        jnp.moveaxis(cache["k"], 1, 0),
+        jnp.moveaxis(cache["v"], 1, 0),
+    )
+    x, (k_new, v_new) = jax.lax.scan(body, x, xs)
+    new_cache = {
+        "k": jnp.moveaxis(k_new, 0, 1),
+        "v": jnp.moveaxis(v_new, 0, 1),
+    }
+    return _mm(x, params["head"]), new_cache
+
+
 # Which width dim of each stacked block leaf ZeRO-3 shards (leaf layout
 # AFTER the stage dim is [L/S, ...]; ln scales stay replicated).
 _ZERO3_WIDTH_DIM = {"qkv": 2, "proj": 1, "w_in": 2, "w_out": 1}

@@ -289,7 +289,7 @@ def _time_jitted(fn, args, *, iters: int, warmup: int = 2) -> float:
 
 
 def decode_phase_breakdown(
-    engine, *, iters: int = 10, warmup: int = 2
+    engine, *, iters: int = 10, warmup: int = 2, spec_decoder=None
 ) -> Dict[str, Any]:
     """Measured per-phase decode cost of a paged serving engine.
 
@@ -307,6 +307,19 @@ def decode_phase_breakdown(
 
     ``decode_step_ms`` is the real step (``engine.decode``), measured the
     same way the SERVE/QUANT artifacts measure it, so shares sum to 1.
+
+    With a ``spec_decoder`` (``spec.SpeculativeDecoder`` over this same
+    engine) two more phases are measured from real spec steps over the
+    live cache — ``draft`` (the K-dispatch draft chain) and ``verify``
+    (the batched verify + readback) — plus the amortization they buy:
+    ``spec_step_ms`` (draft + verify) and ``ms_per_committed_token``
+    (spec step wall over tokens committed).  That last number is the one
+    :func:`attribute_regression` needs to name an ACCEPTANCE-RATE
+    collapse: when acceptance dies, ``draft``/``verify`` phase times
+    barely move but every verify commits ~1 token, so the per-token cost
+    balloons — the breakdown records ``tokens_per_verify`` so the
+    attribution can say "the drafter stopped being believed", not just
+    "decode got slower".
     """
     import jax
     import jax.numpy as jnp
@@ -366,7 +379,7 @@ def decode_phase_breakdown(
         "attention_mlp_other": round(residual * 1e3, 3),
     }
     total = max(t_decode, 1e-12)
-    return {
+    out = {
         "decode_step_ms": round(t_decode * 1e3, 3),
         "kv_dtype": engine.kv_dtype,
         "weights_dtype": engine.weights_dtype,
@@ -376,6 +389,47 @@ def decode_phase_breakdown(
         },
         "iters": iters,
     }
+
+    if spec_decoder is not None:
+        # real spec steps over the live cache, same end positions as the
+        # decode timing above — committed tokens measured, not assumed,
+        # so an acceptance collapse shows up HERE as ms_per_committed_
+        # token exploding while draft/verify stay flat
+        K = spec_decoder.draft_tokens
+        s_pos = np.full(
+            engine.batch_slots, max(0, engine.max_seq - 2 - K), np.int32
+        )
+        s_tokens = np.ones(engine.batch_slots, np.int32)
+        dlen = np.minimum(
+            np.full(engine.batch_slots, K, np.int32),
+            engine.max_seq - 1 - s_pos,
+        ).astype(np.int32)
+        keep = np.ones(engine.batch_slots, np.int32)
+        for _ in range(warmup):
+            spec_decoder.step(s_tokens, s_pos, dlen)
+            spec_decoder.rollback(s_pos, keep)
+        draft_s = verify_s = 0.0
+        committed = 0
+        for _ in range(iters):
+            res = spec_decoder.step(s_tokens, s_pos, dlen)
+            draft_s += res.draft_s
+            verify_s += res.verify_s
+            committed += int(res.accepted.sum()) + engine.batch_slots
+            spec_decoder.rollback(s_pos, keep)
+        t_draft = draft_s / iters
+        t_verify = verify_s / iters
+        tokens_per_verify = committed / (iters * engine.batch_slots)
+        spec_total = t_draft + t_verify
+        phases_ms["draft"] = round(t_draft * 1e3, 3)
+        phases_ms["verify"] = round(t_verify * 1e3, 3)
+        out["spec_step_ms"] = round(spec_total * 1e3, 3)
+        out["drafter"] = spec_decoder.drafter_name
+        out["draft_tokens"] = K
+        out["tokens_per_verify"] = round(tokens_per_verify, 4)
+        out["ms_per_committed_token"] = round(
+            spec_total * 1e3 / max(tokens_per_verify, 1e-9), 3
+        )
+    return out
 
 
 def attribute_regression(

@@ -18,7 +18,10 @@ committed revision artifact:
   work (ROADMAP Open item 2) can script against it;
 - ``SERVE_RESILIENCE_*`` artifacts validate against the serving chaos
   schema (clean/faulted FleetReport pair, gate booleans, fleet timeline
-  event digest) — the evidence the fleet's failover story rests on.
+  event digest) — the evidence the fleet's failover story rests on;
+- ``SPEC_*`` artifacts validate against the speculative-decoding schema
+  (per-drafter acceptance_rate in [0, 1], tokens_per_verify >= 1, the
+  bit-identical and decode-speedup gate booleans).
 """
 
 from __future__ import annotations
@@ -31,10 +34,16 @@ __all__ = [
     "validate_artifact",
     "validate_obs_payload",
     "validate_serve_resilience_payload",
+    "validate_spec_payload",
 ]
 
 #: latency blocks whose percentile keys are a cross-artifact contract
-PERCENTILE_BLOCKS = ("ttft_s", "decode_step_s", "queue_wait_s", "tpot_s")
+#: (an EMPTY dict under these names means "no samples" — e.g. the spec
+#: blocks of a non-speculative run — and is skipped, not rejected)
+PERCENTILE_BLOCKS = (
+    "ttft_s", "decode_step_s", "queue_wait_s", "tpot_s",
+    "draft_step_s", "verify_step_s",
+)
 
 
 class SchemaError(ValueError):
@@ -45,7 +54,7 @@ def _check_percentile_blocks(node: Any, path: str, errors: List[str]) -> None:
     if isinstance(node, dict):
         for key, value in node.items():
             where = f"{path}.{key}" if path else str(key)
-            if key in PERCENTILE_BLOCKS and isinstance(value, dict):
+            if key in PERCENTILE_BLOCKS and isinstance(value, dict) and value:
                 for pk in ("p50", "p99"):
                     if not isinstance(value.get(pk), (int, float)):
                         errors.append(
@@ -218,6 +227,83 @@ def validate_serve_resilience_payload(payload: Dict[str, Any]) -> None:
         raise SchemaError("; ".join(errors))
 
 
+def validate_spec_payload(payload: Dict[str, Any]) -> None:
+    """Strict schema for the ``SPEC_r{NN}.json`` artifact body.
+
+    Speculative decoding's evidence trail: every drafter must report a
+    sane acceptance rate (in [0, 1]), an amortization factor of at least
+    one token per verify (each verify commits >= 1 token by
+    construction — anything lower means the accounting broke), its
+    bit-identical verdict, and the artifact must carry both gate
+    booleans (bit-identical output AND the decode-phase tok/s win).
+    """
+    errors: List[str] = []
+
+    def require(cond: bool, msg: str) -> None:
+        if not cond:
+            errors.append(msg)
+
+    for key in ("metric", "value", "unit", "bench_revision", "platform",
+                "virtual_pod", "draft_tokens", "baseline", "drafters",
+                "gates"):
+        require(key in payload, f"missing top-level key {key!r}")
+
+    baseline = payload.get("baseline")
+    if isinstance(baseline, dict):
+        require(
+            isinstance(
+                baseline.get("decode_tokens_per_sec"), (int, float)
+            ),
+            "baseline.decode_tokens_per_sec must be numeric",
+        )
+    else:
+        require(False, "baseline must be a dict")
+
+    drafters = payload.get("drafters")
+    if isinstance(drafters, dict) and drafters:
+        for name, d in drafters.items():
+            if not isinstance(d, dict):
+                require(False, f"drafters[{name!r}] must be a dict")
+                continue
+            acc = d.get("acceptance_rate")
+            require(
+                isinstance(acc, (int, float)) and 0.0 <= acc <= 1.0,
+                f"drafters[{name!r}].acceptance_rate must be in [0, 1]",
+            )
+            tpv = d.get("tokens_per_verify")
+            require(
+                isinstance(tpv, (int, float)) and tpv >= 1.0,
+                f"drafters[{name!r}].tokens_per_verify must be >= 1 "
+                "(every verify step commits at least the bonus token)",
+            )
+            require(
+                isinstance(d.get("bit_identical"), bool),
+                f"drafters[{name!r}].bit_identical must be a bool",
+            )
+            require(
+                isinstance(
+                    d.get("decode_tokens_per_sec"), (int, float)
+                ),
+                f"drafters[{name!r}].decode_tokens_per_sec must be "
+                "numeric",
+            )
+    else:
+        require(False, "drafters must be a non-empty dict")
+
+    gates = payload.get("gates")
+    if isinstance(gates, dict):
+        for gk in ("bit_identical", "spec_decode_speedup"):
+            require(
+                isinstance(gates.get(gk), bool),
+                f"gates.{gk} must be a bool",
+            )
+    else:
+        require(False, "gates must be a dict")
+
+    if errors:
+        raise SchemaError("; ".join(errors))
+
+
 def validate_artifact(path: str) -> Any:
     """Validate one committed artifact file; returns the parsed JSON.
 
@@ -251,6 +337,11 @@ def validate_artifact(path: str) -> Any:
     if base.startswith("SERVE_RESILIENCE_") and isinstance(data, dict):
         try:
             validate_serve_resilience_payload(data)
+        except SchemaError as exc:
+            errors.append(str(exc))
+    if base.startswith("SPEC_") and isinstance(data, dict):
+        try:
+            validate_spec_payload(data)
         except SchemaError as exc:
             errors.append(str(exc))
 

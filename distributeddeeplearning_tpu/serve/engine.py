@@ -205,6 +205,8 @@ class InferenceEngine:
         self.mesh = mesh
         self.pad_id = pad_id
         self.vocab_size = params["head"].shape[1]
+        # exposed for the spec decoder's greedy-only guard
+        self.temperature = float(temperature)
         if cache_dtype is None:
             cache_dtype = params["embed"].dtype
         # provenance the ServeReport carries: an int8 artifact must be
@@ -288,6 +290,20 @@ class InferenceEngine:
             finite = jnp.isfinite(logits).all(axis=-1)
             return _sample(logits, step), finite, cache
 
+        def _scrub_fn(cache, slot, from_pos):
+            # zero positions >= from_pos of one slot's row, all leaves;
+            # slot AND from_pos are traced so quarantine/rollback never
+            # pay a recompile per call site
+            keep_mask = jnp.arange(max_seq) < from_pos  # [S]
+            out = {}
+            for key, leaf in cache.items():
+                row = leaf[slot]  # [L, S, ...]
+                m = keep_mask.reshape((1, max_seq) + (1,) * (row.ndim - 2))
+                out[key] = leaf.at[slot].set(
+                    jnp.where(m, row, jnp.zeros((), leaf.dtype))
+                )
+            return out
+
         # one compiled prefill per prompt bucket (jit cache keyed on P)
         self._prefill_jit = jax.jit(_prefill_fn)
         self._insert_jit = jax.jit(
@@ -297,6 +313,7 @@ class InferenceEngine:
             _decode_fn, donate_argnums=(1,), **jit_kw
         )
         self._sample_jit = jax.jit(_sample)
+        self._scrub_jit = jax.jit(_scrub_fn, donate_argnums=(0,))
         logger.info(
             "engine: %d slots x seq %d, %d layers, cache %.1f MB (%s)%s",
             batch_slots, max_seq, num_layers,
@@ -407,13 +424,19 @@ class InferenceEngine:
         self._cache = c
 
     def scrub_slot(self, slot: int, from_pos: int = 0) -> None:
-        """Zero the slot's cache row (quarantine cleanup): dense rows are
-        fully private, so the whole row goes — no NaN survives for the
-        slot's next occupant."""
-        c = dict(self._cache)
-        for key in c:
-            c[key] = c[key].at[slot].set(0)
-        self._cache = c
+        """Zero the slot's cache row from position ``from_pos`` on.
+
+        Positions ``< from_pos`` are preserved BIT-EXACT — the partial
+        form is the rollback primitive speculative decoding's rejected
+        tails go through (``from_pos`` = first rejected position) and
+        what the NaN quarantine calls with ``from_pos`` = the delivery's
+        prompt length (scrub exactly the decode-written region).  Dense
+        rows are fully private, so there is no shared state to protect.
+        One compiled program serves every (slot, from_pos): both are
+        traced."""
+        self._cache = self._scrub_jit(
+            self._cache, jnp.int32(slot), jnp.int32(from_pos)
+        )
 
 
 class PrefillTask:
@@ -499,6 +522,8 @@ class PagedInferenceEngine:
         self.page_size = page_size
         self.prefill_chunk = prefill_chunk
         self.pad_id = pad_id
+        # exposed for the spec decoder's greedy-only guard
+        self.temperature = float(temperature)
         self.mesh = None
         self.vocab_size = params["head"].shape[1]
         if cache_dtype is None:
@@ -585,6 +610,26 @@ class PagedInferenceEngine:
                 return _sample(logits, step), logits, finite, cache
             return _sample(logits, step), finite, cache
 
+        def _scrub_fn(cache, page_ids, from_offs):
+            # zero offsets >= from_offs[i] of page page_ids[i], every
+            # leaf; untouched lanes point at the scratch page with
+            # from_offs = page_size (an empty mask) so one compiled
+            # program covers every (slot, from_pos) combination
+            zero = (
+                jnp.arange(page_size)[None, :] >= from_offs[:, None]
+            )  # [nb, ps]
+            out = {}
+            for key, leaf in cache.items():
+                rows = leaf[page_ids]  # [nb, L, ps, ...]
+                m = zero.reshape(
+                    (zero.shape[0], 1, page_size)
+                    + (1,) * (rows.ndim - 3)
+                )
+                out[key] = leaf.at[page_ids].set(
+                    jnp.where(m, jnp.zeros((), leaf.dtype), rows)
+                )
+            return out
+
         # one compiled chunk program per chunk shape (<= log2(chunk) of
         # them: full chunks plus power-of-two final-chunk buckets)
         self._chunk_jit = jax.jit(_chunk_fn, donate_argnums=(1,))
@@ -592,6 +637,7 @@ class PagedInferenceEngine:
             _decode_fn, donate_argnums=(1,), static_argnums=(6,)
         )
         self._sample_jit = jax.jit(_sample)
+        self._scrub_jit = jax.jit(_scrub_fn, donate_argnums=(0,))
         logger.info(
             "paged engine: %d slots, %d pages x %d tokens (+scratch), %d "
             "layers, pool %.1f MB (%s), chunk %d, prefix cache %s",
@@ -873,20 +919,45 @@ class PagedInferenceEngine:
         self._cache = c
 
     def scrub_slot(self, slot: int, from_pos: int = 0) -> None:
-        """Zero the slot's pages from the one covering ``from_pos`` on
-        (quarantine cleanup).  With ``from_pos`` = the delivery's prompt
-        length this scrubs exactly the decode-written region — pages that
-        are private by construction; earlier (possibly prefix-shared)
-        pages hold only finite prompt K/V and are left alone."""
+        """Zero the slot's cache from logical position ``from_pos`` on,
+        POSITION-granular: within the boundary page only offsets
+        ``>= from_pos % page_size`` are zeroed, so positions
+        ``< from_pos`` survive bit-exact — the rollback primitive
+        speculative decoding's rejected tails go through, and the NaN
+        quarantine's cleanup (``from_pos`` = the delivery's prompt
+        length scrubs exactly the decode-written region).
+
+        Prefix-SHARED pages are never written: every touched page must be
+        private to this slot (refcount 1, unpublished) — with ``from_pos
+        >=`` the shared-prefix length that holds by construction (shared
+        pages only ever cover full prompt pages below it), and a caller
+        that would violate it gets a loud error instead of corrupting
+        other slots' history.  One compiled program serves every
+        (slot, from_pos)."""
         pages = self._slot_pages.get(slot, [])
         if not pages:
             return
-        c = dict(self._cache)
-        for idx in range(from_pos // self.page_size, len(pages)):
-            page = pages[idx]
-            for key in c:
-                c[key] = c[key].at[page].set(0)
-        self._cache = c
+        ps = self.page_size
+        start = from_pos // ps
+        if start >= len(pages):
+            return
+        shared = [
+            p for p in pages[start:] if self.allocator.is_shared(p)
+        ]
+        if shared:
+            raise ValueError(
+                f"scrub_slot(slot={slot}, from_pos={from_pos}) would "
+                f"write prefix-shared page(s) {shared} — shared pages "
+                "are immutable; scrub only from the private region on"
+            )
+        ids = np.full(self.blocks_per_slot, SCRATCH_PAGE, np.int32)
+        offs = np.full(self.blocks_per_slot, ps, np.int32)  # ps = no-op
+        for idx in range(start, len(pages)):
+            ids[idx] = pages[idx]
+            offs[idx] = max(0, from_pos - idx * ps)
+        self._cache = self._scrub_jit(
+            self._cache, jnp.asarray(ids), jnp.asarray(offs)
+        )
 
     def release(self, slot: int) -> None:
         """Return the slot's pages to the pool.  Prefix-registered pages

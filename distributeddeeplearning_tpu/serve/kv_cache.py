@@ -300,6 +300,14 @@ class PageAllocator:
     def refcount(self, page: int) -> int:
         return self._rc.get(page, 0)
 
+    def is_shared(self, page: int) -> bool:
+        """True when writing this page could corrupt state beyond one
+        slot: it is mapped by more than one block table (refcount > 1)
+        or published in the prefix table (future hits would resurrect
+        its contents).  The scrub/rollback paths refuse to touch such
+        pages — shared pages are immutable by contract."""
+        return self._rc.get(page, 0) > 1 or page in self._page_key
+
     # -- prefix table ------------------------------------------------------
     def lookup_prefix(self, key) -> Optional[int]:
         """Page holding ``key``'s chunk, or None.  Does NOT incref — the

@@ -72,6 +72,17 @@ boundary; every failure mode is scoped to ONE request, never the batch:
 Deterministic chaos for all of it comes from ``DDLT_FAULTS``
 (``decode_nan`` / ``decode_stall`` / ``reject_admit`` — see
 :mod:`..utils.faults`).
+
+Speculative decoding (PR 8, ``spec/``): with a ``spec_decoder`` each
+loop iteration drafts K tokens and verifies all K+1 in one batched call,
+so slots advance a VARIABLE number of tokens per step (1..K+1, greedy
+output bit-identical to non-speculative decode).  The scheduler's share
+of the contract is small: cap each slot's draft length so the verify
+write horizon stays inside its budget/page reservation, cut committed
+tokens at EOS, dispatch the batched rollback for rejected tails BEFORE
+releasing finishing slots, and report ``acceptance_rate`` /
+``tokens_per_verify`` / draft & verify step percentiles alongside the
+new decode-phase-only ``decode_tokens_per_sec``.
 """
 
 from __future__ import annotations
@@ -198,6 +209,26 @@ class ServeReport:
     decode_retries: int = 0
     quarantined: int = 0
     drained: bool = False
+    # decode-phase-only throughput: generated tokens over the summed wall
+    # of the decode/spec steps alone.  ``tokens_per_sec`` divides by the
+    # WHOLE run wall (prefill + compile + admission included), which
+    # skews cross-config comparisons whenever prompt mixes or compile
+    # budgets differ — this is the number decode-path changes (quant,
+    # speculative decoding) are judged on
+    decode_tokens_per_sec: float = 0.0
+    # speculative decoding (spec/): provenance + the two numbers the
+    # SPEC artifact gates on.  acceptance_rate = accepted drafts over
+    # proposed drafts; tokens_per_verify = tokens committed per slot per
+    # verify step (>= 1 — the amortization factor a spec config buys)
+    speculative: bool = False
+    drafter: Optional[str] = None
+    draft_tokens: int = 0
+    acceptance_rate: Optional[float] = None
+    tokens_per_verify: Optional[float] = None
+    # host wall of the draft dispatch chain / the verify dispatch +
+    # readback, per spec step (zero-filled blocks on non-spec runs)
+    draft_step_s: Dict[str, float] = dataclasses.field(default_factory=dict)
+    verify_step_s: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -263,6 +294,7 @@ class ContinuousBatchingScheduler:
         watchdog_deadline_s: Optional[float] = None,
         watchdog_on_timeout: Optional[Callable[[], None]] = None,
         result_window: Optional[int] = None,
+        spec_decoder=None,
     ):
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
@@ -302,6 +334,18 @@ class ContinuousBatchingScheduler:
                 f"result_window must be >= 1, got {result_window}"
             )
         self.result_window = result_window
+        # speculative decoding (spec.SpeculativeDecoder over this same
+        # engine): each loop iteration drafts K tokens and verifies all
+        # K+1 in one batched call, so slots advance a VARIABLE number of
+        # tokens per step (1..K+1).  The decoder enforces greedy + f32
+        # cache at construction; the scheduler only has to cap per-slot
+        # draft lengths (budget / max_seq) and roll back rejected tails.
+        if spec_decoder is not None and spec_decoder.engine is not engine:
+            raise ValueError(
+                "spec_decoder was built over a different engine than the "
+                "scheduler drives — their caches would diverge silently"
+            )
+        self.spec_decoder = spec_decoder
         self._cancelled: set = set()
 
     def request_cancel(self, uid: str) -> None:
@@ -377,6 +421,11 @@ class ContinuousBatchingScheduler:
         prefilling: deque = deque()
         tokens_buf = np.zeros(slots, np.int32)
         pos_buf = np.zeros(slots, np.int32)
+        # speculative decoding state: per-slot draft caps going in, kept
+        # token counts coming out (keep == K+1 means "no rejected tail")
+        spec = self.spec_decoder
+        dlen_buf = np.zeros(slots, np.int32)
+        keep_buf = np.zeros(slots, np.int32)
         # bounded when result_window is set (live mode) — see __init__.
         # Per-step timing/occupancy feed ONLY end-of-run aggregates, so
         # they stream into the obs histogram / running sums (O(1) memory
@@ -385,11 +434,25 @@ class ContinuousBatchingScheduler:
         # report block already routes through)
         results: deque = deque(maxlen=self.result_window)
         step_hist = Histogram("serve.decode_step_s")
+        draft_hist = Histogram("serve.draft_step_s")
+        verify_hist = Histogram("serve.verify_step_s")
         occ_sum = 0.0
         occ_n = 0               # attempted decode steps (incl. failed)
         n_decode_steps = 0      # exact count
         generated_count = 0     # exact token total (results may be windowed)
         prompt_tokens = 0
+        # decode-phase-only accounting (the decode_tokens_per_sec
+        # satellite): tokens produced by decode/spec steps over the
+        # summed wall of exactly those steps — prefill, admission and
+        # compile time excluded by construction
+        decode_wall = 0.0
+        decode_tokens = 0
+        # spec accounting: proposed vs accepted drafts, committed tokens
+        # per slot-verify (the amortization factor)
+        spec_drafted = 0
+        spec_accepted = 0
+        spec_committed = 0
+        spec_slot_steps = 0
         finish_reasons: Dict[str, int] = {}
         meta: Dict[str, _ReqMeta] = {}
 
@@ -888,9 +951,24 @@ class ContinuousBatchingScheduler:
                         time.sleep(0.001)
                     continue
 
+                if spec is not None:
+                    dlen_buf[:] = 0  # stale lanes must not draft
                 for slot, st in active.items():
                     tokens_buf[slot] = st.generated[-1]
                     pos_buf[slot] = st.next_pos
+                    if spec is not None:
+                        # per-slot draft cap: emitted tokens (accepted +
+                        # bonus) never exceed the remaining budget, so
+                        # the verify write horizon stays inside the
+                        # worst-case page reservation made at admission,
+                        # and never walks off the position table.  0 =
+                        # this slot runs a plain decode step through the
+                        # verify program.
+                        dlen_buf[slot] = max(0, min(
+                            spec.draft_tokens,
+                            st.budget - len(st.generated) - 1,
+                            engine.max_seq - 1 - st.next_pos,
+                        ))
                 occ_sum += len(active) / slots
                 occ_n += 1
                 decode_step = n_decode_steps + 1  # 1-based, the fault clock
@@ -921,9 +999,21 @@ class ContinuousBatchingScheduler:
                                 )
                             poison(victim, active[victim].next_pos - 1)
                 t0 = time.perf_counter()
+                res = None
                 try:
-                    with trace.span("serve/decode_step", active=len(active)):
-                        out = engine.decode(tokens_buf, pos_buf)
+                    if spec is not None:
+                        # draft K + verify K+1 in one batched call; one
+                        # readback carries tokens/acceptance/finiteness
+                        with trace.span(
+                            "serve/spec_step", active=len(active)
+                        ):
+                            res = spec.step(tokens_buf, pos_buf, dlen_buf)
+                        out = None
+                    else:
+                        with trace.span(
+                            "serve/decode_step", active=len(active)
+                        ):
+                            out = engine.decode(tokens_buf, pos_buf)
                 except Exception as exc:  # noqa: BLE001
                     # The decode step failed batch-wide through no fault of
                     # any single request (a hung collective, a dispatch bug):
@@ -937,13 +1027,30 @@ class ContinuousBatchingScheduler:
                             f"decode failed: {type(exc).__name__}: {exc}",
                         )
                     continue
-                step_hist.record(time.perf_counter() - t0)  # host math only
+                step_wall = time.perf_counter() - t0  # host math only
+                step_hist.record(step_wall)
+                decode_wall += step_wall
                 n_decode_steps += 1
+                if res is not None:
+                    draft_hist.record(res.draft_s)
+                    verify_hist.record(res.verify_s)
+                    # full acceptance leaves no rejected tail to scrub
+                    keep_buf[:] = spec.draft_tokens + 1
+                    rollback_needed = False
 
                 # NaN quarantine: engines report per-slot logit finiteness
                 # from the SAME jitted step (no extra sync).  A poisoned slot
                 # is scrubbed and fails alone — the batch decodes on.
-                finite = getattr(engine, "last_finite", None)
+                finite = (
+                    res.finite if res is not None
+                    else getattr(engine, "last_finite", None)
+                )
+                # spec mode defers completions until AFTER the batched
+                # rollback: complete() releases the slot (paged: block
+                # table row back to SCRATCH), and a rollback dispatched
+                # after that would zero the dustbin instead of the freed
+                # pages' rejected-draft tail
+                finished: List = []
                 for slot, st in list(active.items()):
                     if finite is not None and not finite[slot]:
                         quarantined += 1
@@ -951,26 +1058,56 @@ class ContinuousBatchingScheduler:
                         if scrub is not None:
                             # zero the slot's decode-written region so the
                             # NaN cannot leak to the next occupant via the
-                            # 0-weight * NaN-value softmax path
+                            # 0-weight * NaN-value softmax path (in spec
+                            # mode this also covers the step's whole
+                            # draft/verify write horizon, so the batched
+                            # rollback can skip the slot)
                             scrub(slot, len(st.req.prompt))
                         trace.event(
                             "serve/request_quarantined", uid=st.req.uid,
                             step=decode_step,
                         )
-                        complete(
+                        finished.append((
                             slot, st, "error",
-                            error="non-finite logits (quarantined at decode "
+                            "non-finite logits (quarantined at decode "
                             f"step {decode_step})",
-                        )
+                        ))
                         continue
-                    tok = int(out[slot])
-                    st.generated.append(tok)
-                    st.next_pos += 1
-                    if on_token is not None:
-                        on_token(st.req.uid, tok)
+                    if res is None:
+                        toks = [int(out[slot])]
+                    else:
+                        # accepted drafts + the verifier's bonus token,
+                        # cut at EOS (the tail past an accepted EOS was
+                        # speculation over a finished sequence)
+                        emitted = int(res.accepted[slot]) + 1
+                        toks = [int(t) for t in res.tokens[slot, :emitted]]
+                        if self.eos_id is not None and self.eos_id in toks:
+                            toks = toks[: toks.index(self.eos_id) + 1]
+                        spec_drafted += int(dlen_buf[slot])
+                        spec_accepted += int(res.accepted[slot])
+                        spec_committed += len(toks)
+                        spec_slot_steps += 1
+                        keep_buf[slot] = len(toks)
+                        if len(toks) <= spec.draft_tokens:
+                            rollback_needed = True
+                    decode_tokens += len(toks)
+                    for tok in toks:
+                        st.generated.append(tok)
+                        if on_token is not None:
+                            on_token(st.req.uid, tok)
+                    st.next_pos += len(toks)
                     reason = self._finished(st)
                     if reason is not None:
-                        complete(slot, st, reason)
+                        finished.append((slot, st, reason, None))
+                if res is not None and rollback_needed:
+                    # ONE batched dispatch zeroes every slot's rejected
+                    # tail (positions >= pos + keep) — the jitted form of
+                    # scrub_slot(slot, from_pos), pinned equivalent in
+                    # tests/test_spec.py; MUST run before the completions
+                    # below release their slots
+                    spec.rollback(pos_buf, keep_buf)
+                for slot, st, reason, err in finished:
+                    complete(slot, st, reason, error=err)
 
                 if on_step is not None:
                     on_step(decode_step)
@@ -1050,6 +1187,23 @@ class ContinuousBatchingScheduler:
             decode_retries=decode_retries,
             quarantined=quarantined,
             drained=draining,
+            decode_tokens_per_sec=(
+                round(decode_tokens / decode_wall, 2)
+                if decode_wall > 0 else 0.0
+            ),
+            speculative=spec is not None,
+            drafter=spec.drafter_name if spec is not None else None,
+            draft_tokens=spec.draft_tokens if spec is not None else 0,
+            acceptance_rate=(
+                round(spec_accepted / spec_drafted, 4)
+                if spec_drafted else None
+            ),
+            tokens_per_verify=(
+                round(spec_committed / spec_slot_steps, 4)
+                if spec_slot_steps else None
+            ),
+            draft_step_s=draft_hist.summary(),
+            verify_step_s=verify_hist.summary(),
         )
         # end-of-run rollup into the process metrics registry (one
         # record_many per stream, NOT per step — the hot loop stays hot):
@@ -1070,7 +1224,24 @@ class ContinuousBatchingScheduler:
         reg.histogram("serve.tpot_s").record_many(tpot)
         reg.histogram("serve.decode_step_s").merge(step_hist)
         reg.gauge("serve.tokens_per_sec").set(report.tokens_per_sec)
+        reg.gauge("serve.decode_tokens_per_sec").set(
+            report.decode_tokens_per_sec
+        )
         reg.gauge("serve.slot_occupancy_mean").set(
             report.slot_occupancy_mean
         )
+        if spec is not None:
+            # the drafter-health gauge obs dashboards watch: an
+            # acceptance-rate collapse is a throughput regression with
+            # unchanged step times (every verify commits ~1 token)
+            if report.acceptance_rate is not None:
+                reg.gauge("serve.acceptance_rate").set(
+                    report.acceptance_rate
+                )
+            if report.tokens_per_verify is not None:
+                reg.gauge("serve.tokens_per_verify").set(
+                    report.tokens_per_verify
+                )
+            reg.histogram("serve.draft_step_s").merge(draft_hist)
+            reg.histogram("serve.verify_step_s").merge(verify_hist)
         return list(results), report

@@ -1,0 +1,60 @@
+"""Speculative decoding: a cheap drafter + one batched verification step.
+
+OBS_r11 attributed the serving latency wall precisely: decode is
+latency-bound on the attention/MLP compute over history, not on scale
+math — every generated token pays a full model pass whose weights and
+cache traffic amortize over exactly one token.  Speculative decoding buys
+tokens-per-step without touching the quality bar: a cheap **drafter**
+proposes K greedy tokens per slot, the full-precision model **verifies**
+all K+1 positions in ONE jitted batched forward
+(``models.pipelined_transformer.forward_verify`` /
+``forward_verify_paged`` — chunk-prefill-style write-then-attend), and
+the acceptance rule runs in-jit: the longest draft prefix whose tokens
+equal the verifier's f32 argmax is committed, plus the verifier's bonus
+token at the first mismatch.  Because every emitted token IS the
+verifier's argmax given the committed history, a speculative greedy run
+is **bit-identical** to non-speculative f32 decode — the subsystem
+extends the repo's decode==full-forward pin rather than weakening it.
+
+Two built-in drafters (``spec.drafter``):
+
+- **truncated** — the first ``draft_layers`` layers of the shared stack
+  plus the shared head: no extra weights, cheap by construction, and its
+  layer-m K/V are bit-identical to the verifier's (layer m sees only
+  layers < m), so its cache writes cost nothing to heal;
+- **int8** — the int8-weight model (``quant.calibrate.quantize_params``
+  or ``Checkpointer.restore_params(quantize_weights="int8")``): the
+  99%+ greedy agreement QUANT_r10 measured becomes draft acceptance.
+
+Rejected draft tails are rolled back on both cache layouts
+(``SpeculativeDecoder.rollback`` — the batched jitted form of
+``engine.scrub_slot(slot, from_pos)``): positions past the accepted
+prefix are zeroed, prefix-shared pages are never written (rollback
+positions are strictly decode-region, private by construction), and a
+forced-rejection run leaves the cache bit-identical to a never-drafted
+run (``tests/test_spec.py`` pins it).
+
+Entry points: ``ddlt serve --speculative --draft-tokens K --draft-layers
+M [--draft-weights int8]`` and ``bench.py --spec`` (the ``SPEC_*.json``
+artifact, gated on bit-identical tokens AND a decode-tokens/s win).
+"""
+
+from distributeddeeplearning_tpu.spec.decode import (
+    SpecStepResult,
+    SpeculativeDecoder,
+)
+from distributeddeeplearning_tpu.spec.drafter import (
+    Drafter,
+    Int8Drafter,
+    TruncatedDrafter,
+    build_drafter,
+)
+
+__all__ = [
+    "Drafter",
+    "TruncatedDrafter",
+    "Int8Drafter",
+    "build_drafter",
+    "SpeculativeDecoder",
+    "SpecStepResult",
+]

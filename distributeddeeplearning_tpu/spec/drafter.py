@@ -1,0 +1,197 @@
+"""Drafters: the cheap proposal half of speculative decoding.
+
+A drafter is anything that can extend every slot by one greedy token
+against the engine's live KV cache.  The contract is deliberately tiny
+(``bind`` once, ``propose`` per draft token) so tests can plug in
+adversarial drafters (e.g. a garbage drafter that forces total rejection
+to pin the rollback path) next to the two production ones:
+
+- :class:`TruncatedDrafter` — the first ``draft_layers`` layers of the
+  SHARED stack plus the shared head.  No extra weights, and its cache
+  writes are self-healing: layer ``m``'s K/V depend only on layers
+  ``< m``, so the truncated stack's writes at layers ``< M`` are
+  bit-identical to what the full verifier recomputes over them.
+- :class:`Int8Drafter` — the full-depth int8-weight model
+  (``quant.calibrate.quantize_params``, or the pytree
+  ``Checkpointer.restore_params(quantize_weights="int8")`` returns).
+  Its K/V writes DIFFER from f32, which is safe by construction: the
+  verifier rewrites every position it accepts before attending
+  (write-then-attend), and the rejected tail is rolled back.
+
+Both drafters write into the engine's cache — drafting needs the drafted
+tokens' own K/V to propose the next one — and rely on the same two
+guarantees: the verifier overwrites every committed position, and the
+spec decoder's rollback scrubs everything past the accepted prefix.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.models.pipelined_transformer import (
+    forward_decode,
+    forward_decode_paged,
+)
+
+PyTree = Any
+
+
+class Drafter:
+    """One greedy draft token per slot against the engine's live cache.
+
+    ``bind(engine)`` is called once by the :class:`~..spec.decode.
+    SpeculativeDecoder`; ``propose(cache, tokens, pos)`` must return
+    ``(next_tokens [B] int32, new_cache)`` as DEVICE values (the draft
+    chain must never sync — the decoder reads back only after the
+    verify dispatch) and may write the drafted tokens' K/V into the
+    cache at ``pos`` (the engine layouts both heal those writes).
+    """
+
+    name = "custom"
+
+    def bind(self, engine) -> None:  # pragma: no cover - trivial default
+        """Prepare jitted programs for ``engine``'s layout."""
+
+    def propose(self, cache, tokens, pos):
+        raise NotImplementedError
+
+
+def _greedy(logits: jax.Array) -> jax.Array:
+    return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+
+
+class _ModelDrafter(Drafter):
+    """Shared machinery: a jitted decode-shaped program over ``dparams``
+    (possibly a truncated stack writing only its own cache layers)."""
+
+    def __init__(self):
+        self._jit = None
+        self._dparams = None
+        self._paged = False
+        self._tables = None  # host hook: read the engine's live tables
+
+    def _make_params(self, engine) -> PyTree:
+        raise NotImplementedError
+
+    def bind(self, engine) -> None:
+        num_heads = engine.num_heads
+        self._dparams = self._make_params(engine)
+        draft_layers = jax.tree_util.tree_leaves(
+            self._dparams["blocks"]
+        )[0].shape[0]
+        M = draft_layers
+        self._paged = engine.kv_layout == "paged"
+
+        if self._paged:
+            page_size = engine.page_size
+            self._tables = lambda: engine.block_tables
+
+            def _fn(dparams, cache, tokens, pos, tables):
+                sub = {"k": cache["k"][:, :M], "v": cache["v"][:, :M]}
+                logits, new_sub = forward_decode_paged(
+                    dparams, tokens, sub, pos, tables,
+                    num_heads=num_heads, page_size=page_size,
+                )
+                out = dict(cache)
+                out["k"] = cache["k"].at[:, :M].set(new_sub["k"])
+                out["v"] = cache["v"].at[:, :M].set(new_sub["v"])
+                return _greedy(logits), out
+        else:
+            def _fn(dparams, cache, tokens, pos):
+                sub = {"k": cache["k"][:, :M], "v": cache["v"][:, :M]}
+                logits, new_sub = forward_decode(
+                    dparams, tokens, sub, pos, num_heads=num_heads
+                )
+                out = dict(cache)
+                out["k"] = cache["k"].at[:, :M].set(new_sub["k"])
+                out["v"] = cache["v"].at[:, :M].set(new_sub["v"])
+                return _greedy(logits), out
+
+        self._jit = jax.jit(_fn, donate_argnums=(1,))
+
+    def propose(self, cache, tokens, pos):
+        if self._paged:
+            return self._jit(
+                self._dparams, cache, tokens, pos,
+                jnp.asarray(self._tables()),
+            )
+        return self._jit(self._dparams, cache, tokens, pos)
+
+
+class TruncatedDrafter(_ModelDrafter):
+    """Self-draft through the first ``draft_layers`` layers + the shared
+    head — the no-extra-weights drafter.  ``draft_layers == num_layers``
+    is allowed (drafter == verifier, acceptance 1.0 by the bit-exactness
+    pin) and useful in tests; production wants it small."""
+
+    name = "truncated"
+
+    def __init__(self, draft_layers: int):
+        super().__init__()
+        if draft_layers < 1:
+            raise ValueError(
+                f"draft_layers must be >= 1, got {draft_layers}"
+            )
+        self.draft_layers = draft_layers
+
+    def _make_params(self, engine) -> PyTree:
+        L = jax.tree_util.tree_leaves(engine.params["blocks"])[0].shape[0]
+        if self.draft_layers > L:
+            raise ValueError(
+                f"draft_layers {self.draft_layers} exceeds the model's "
+                f"{L} layers"
+            )
+        M = self.draft_layers
+        dparams = dict(engine.params)
+        # QTensor block leaves slice transparently: the leading dim of
+        # every leaf (values AND keepdims scales) is the layer stack
+        dparams["blocks"] = jax.tree_util.tree_map(
+            lambda a: a[:M], engine.params["blocks"]
+        )
+        return dparams
+
+
+class Int8Drafter(_ModelDrafter):
+    """Full-depth int8-weight drafter: QUANT_r10's 99%+ greedy agreement
+    becomes draft acceptance.  ``params`` overrides the weights (e.g. the
+    pytree ``Checkpointer.restore_params(quantize_weights="int8")``
+    returns); otherwise the engine's f32 params are PTQ-quantized in
+    memory at bind time."""
+
+    name = "int8"
+
+    def __init__(self, params: Optional[PyTree] = None):
+        super().__init__()
+        self._override = params
+
+    def _make_params(self, engine) -> PyTree:
+        if self._override is not None:
+            return self._override
+        from distributeddeeplearning_tpu.quant.calibrate import (
+            params_dtype,
+            quantize_params,
+        )
+
+        if params_dtype(engine.params) == "int8":
+            # the engine itself serves int8 weights — drafting with the
+            # same pytree is free (and acceptance is 1.0 by bit-exactness)
+            return engine.params
+        return quantize_params(engine.params)
+
+
+def build_drafter(
+    kind: str, *, draft_layers: Optional[int] = None,
+    params: Optional[PyTree] = None,
+) -> Drafter:
+    """Drafter factory behind the ``--draft-weights`` / ``--draft-layers``
+    flags: ``"truncated"`` (requires ``draft_layers``) or ``"int8"``."""
+    if kind == "truncated":
+        if draft_layers is None:
+            raise ValueError("the truncated drafter needs draft_layers")
+        return TruncatedDrafter(draft_layers)
+    if kind == "int8":
+        return Int8Drafter(params)
+    raise ValueError(f"unknown drafter kind {kind!r}")

@@ -22,7 +22,11 @@ unless allow-listed.
 import inspect
 import re
 
-BANNED = re.compile(r"(?<![\w.])float\(|\.item\(\)|np\.asarray|device_get")
+# (?<![\w.]) on np.asarray keeps jnp.asarray — a host->device upload,
+# dispatch-only — from false-positives; bare np.asarray IS a readback
+BANNED = re.compile(
+    r"(?<![\w.])float\(|\.item\(\)|(?<![\w.])np\.asarray|device_get"
+)
 MARKER = "sync-ok"
 
 
@@ -168,6 +172,58 @@ def test_fleet_dispatch_loop_has_no_unmarked_host_sync():
         "cross the process boundary).  Move the work into the replica "
         "workers, or tag a deliberate documented price with "
         f"'# {MARKER}':\n  " + "\n  ".join(offenders)
+    )
+
+
+def _spec_step_body():
+    """Source lines of ``SpeculativeDecoder.step`` — the draft->verify
+    hot loop speculative serving runs once per scheduler iteration: K
+    device-chained draft dispatches, one batched verify dispatch, and
+    exactly ONE designed readback (the committed tokens + acceptance +
+    finiteness riding a single sync)."""
+    from distributeddeeplearning_tpu.spec.decode import SpeculativeDecoder
+
+    return inspect.getsource(SpeculativeDecoder.step).splitlines()
+
+
+def test_spec_draft_verify_loop_has_no_unmarked_host_sync():
+    """The spec step's budget is the same as ``engine.decode``'s: one
+    readback per step, everything else dispatch-only.  A host sync
+    between draft dispatches would serialize the whole chain (K round
+    trips instead of one), so any banned token here must carry a
+    ``# sync-ok`` marker with its justification."""
+    body = _spec_step_body()
+    # right-region guards: the source we grep must contain BOTH halves
+    # of the loop — the draft dispatch chain and the verify dispatch
+    assert any("drafter.propose" in line for line in body), (
+        "spec lint is not scanning the draft dispatch chain"
+    )
+    assert any("self._verify_jit" in line for line in body), (
+        "spec lint is not scanning the verify dispatch"
+    )
+    offenders = [
+        line.strip()
+        for line in body
+        if BANNED.search(line) and MARKER not in line
+    ]
+    assert not offenders, (
+        "host-sync token in the spec draft->verify loop — a sync between "
+        "draft dispatches serializes the chain into K round trips.  "
+        "Batch it into the verify readback, or tag a deliberate "
+        f"documented price with '# {MARKER}':\n  " + "\n  ".join(offenders)
+    )
+
+
+def test_spec_step_allowlist_is_alive():
+    """The designed readback (committed tokens/acceptance/finiteness)
+    carries the marker — if it moves, the lint must follow it."""
+    body = _spec_step_body()
+    marked = [
+        line for line in body if MARKER in line and BANNED.search(line)
+    ]
+    assert marked, (
+        "no allow-listed sync lines found in SpeculativeDecoder.step — "
+        "lint may be scanning the wrong region"
     )
 
 

@@ -1,0 +1,702 @@
+"""Speculative decoding: bit-exact verification, rollback, drafters.
+
+The load-bearing guarantee extends the repo's oldest serving pin
+(decode == full forward, dense == paged): a speculative greedy run must
+produce tokens BIT-IDENTICAL to the non-speculative f32 run, whatever
+the drafter proposes — every emitted token is the verifier's own f32
+argmax over the committed history, so the drafter can only change HOW
+FAST tokens appear, never WHICH tokens.  On top of that:
+
+- ``forward_verify`` / ``forward_verify_paged`` logits are pinned
+  bitwise against a sequential ``forward_decode`` walk, position for
+  position, including the cache writes;
+- rejected draft tails roll back to EXACTLY the never-drafted cache
+  state (a forced-total-rejection run's cache equals a non-speculative
+  run's, both layouts) — the batched rollback is pinned equivalent to
+  the host ``scrub_slot(slot, from_pos)`` path;
+- ``scrub_slot(from_pos > 0)`` partial rollback is pinned directly on
+  both layouts: positions below ``from_pos`` preserved bit-exact,
+  positions at/above zeroed, prefix-SHARED pages never written;
+- the greedy-only / f32-cache-only guards, the spec ServeReport fields,
+  the SPEC artifact schema, and the ``bench.py --spec`` CPU smoke.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.models.pipelined_transformer import (
+    forward_decode,
+    forward_decode_paged,
+    forward_prefill,
+    forward_verify,
+    forward_verify_paged,
+    init_params,
+)
+from distributeddeeplearning_tpu.serve import (
+    ContinuousBatchingScheduler,
+    InferenceEngine,
+    PagedInferenceEngine,
+    Request,
+    synthetic_requests,
+)
+from distributeddeeplearning_tpu.spec import (
+    Drafter,
+    SpeculativeDecoder,
+    build_drafter,
+)
+from distributeddeeplearning_tpu.utils import faults as faults_mod
+
+CFG = dict(num_layers=4, d_model=32, num_heads=4, d_ff=64, vocab_size=61,
+           max_len=64)
+HEADS = CFG["num_heads"]
+MAX_SEQ = CFG["max_len"]
+
+
+@pytest.fixture(autouse=True)
+def _no_inherited_faults():
+    faults_mod.install_plan("")
+    yield
+    faults_mod.install_plan("")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.key(0), **CFG)
+
+
+def _dense(params, slots=3, **kw):
+    kw.setdefault("rng", jax.random.key(1))
+    return InferenceEngine(
+        params, num_heads=HEADS, batch_slots=slots, max_seq=MAX_SEQ, **kw
+    )
+
+
+def _paged(params, slots=3, **kw):
+    kw.setdefault("rng", jax.random.key(1))
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    return PagedInferenceEngine(
+        params, num_heads=HEADS, batch_slots=slots, max_seq=MAX_SEQ, **kw
+    )
+
+
+def _requests(n=7, vocab=CFG["vocab_size"], max_prompt=12, seed=0):
+    return [
+        Request(uid=r.uid, prompt=list(r.prompt))
+        for r in synthetic_requests(
+            n, vocab_size=vocab, max_prompt=max_prompt, min_prompt=3,
+            rng=np.random.default_rng(seed),
+        )
+    ]
+
+
+def _run(engine, spec_decoder=None, max_new_tokens=9, eos_id=None,
+         reqs=None):
+    results, report = ContinuousBatchingScheduler(
+        engine, max_new_tokens=max_new_tokens, eos_id=eos_id,
+        spec_decoder=spec_decoder,
+    ).run(reqs if reqs is not None else _requests())
+    return {r.uid: r.tokens for r in results}, report
+
+
+# --------------------------------------------------------------------------
+# model level: the batched verify IS a sequential decode walk, bitwise
+# --------------------------------------------------------------------------
+
+def _seed_dense_slot(params, engine, slot, prompt):
+    logits, k, v = forward_prefill(
+        params, jnp.asarray([prompt], jnp.int32), num_heads=HEADS
+    )
+    from distributeddeeplearning_tpu.serve import insert_sequence
+
+    engine._cache = insert_sequence(engine._cache, k, v, slot)
+
+
+@pytest.mark.parametrize("layout", ["dense", "paged"])
+def test_verify_matches_sequential_decode_bitwise(params, layout):
+    """Per-position logits of ONE batched verify == K1 sequential decode
+    steps, bitwise, and the cache writes match too — the foundation the
+    whole acceptance rule stands on."""
+    B, K1, plen = 3, 4, 6
+    rng = np.random.default_rng(3)
+    prompts = rng.integers(1, CFG["vocab_size"], (B, plen)).tolist()
+    pend = np.asarray(rng.integers(1, CFG["vocab_size"], B), np.int32)
+
+    def build():
+        eng = (_dense if layout == "dense" else _paged)(params, slots=B)
+        for i, p in enumerate(prompts):
+            if layout == "dense":
+                _seed_dense_slot(params, eng, i, p)
+            else:
+                eng.prefill(i, p, max_new_tokens=K1 + 2)
+        return eng
+
+    # sequential greedy walk
+    eng_a = build()
+    toks, pos = pend.copy(), np.full(B, plen, np.int32)
+    seq_logits = []
+    for _ in range(K1):
+        if layout == "dense":
+            lg, eng_a._cache = forward_decode(
+                params, jnp.asarray(toks), eng_a._cache,
+                jnp.asarray(pos), num_heads=HEADS,
+            )
+        else:
+            lg, eng_a._cache = forward_decode_paged(
+                params, jnp.asarray(toks), eng_a._cache,
+                jnp.asarray(pos), jnp.asarray(eng_a.block_tables),
+                num_heads=HEADS, page_size=eng_a.page_size,
+            )
+        seq_logits.append(np.asarray(lg))
+        toks = np.asarray(jnp.argmax(lg, -1)).astype(np.int32)
+        pos += 1
+    seq_logits = np.stack(seq_logits, axis=1)  # [B, K1, V]
+
+    # one batched verify fed the same greedy chain as drafts
+    eng_b = build()
+    mat = np.zeros((B, K1), np.int32)
+    mat[:, 0] = pend
+    for j in range(1, K1):
+        mat[:, j] = np.argmax(seq_logits[:, j - 1], -1)
+    dlen = np.full(B, K1 - 1, np.int32)
+    if layout == "dense":
+        vlog, vcache = forward_verify(
+            params, jnp.asarray(mat), eng_b._cache,
+            jnp.asarray(np.full(B, plen, np.int32)), jnp.asarray(dlen),
+            num_heads=HEADS,
+        )
+    else:
+        vlog, vcache = forward_verify_paged(
+            params, jnp.asarray(mat), eng_b._cache,
+            jnp.asarray(np.full(B, plen, np.int32)), jnp.asarray(dlen),
+            jnp.asarray(eng_b.block_tables),
+            num_heads=HEADS, page_size=eng_b.page_size,
+        )
+    np.testing.assert_array_equal(np.asarray(vlog), seq_logits)
+    # cache parity: verify wrote exactly what the sequential walk wrote
+    for key in ("k", "v"):
+        np.testing.assert_array_equal(
+            np.asarray(vcache[key]), np.asarray(eng_a._cache[key])
+        )
+
+
+def test_verify_rejects_int8_cache(params):
+    cache = {"k": jnp.zeros((1, 1, 4, 2, 2), jnp.int8),
+             "v": jnp.zeros((1, 1, 4, 2, 2), jnp.int8),
+             "k_scale": jnp.zeros((1, 1, 4, 2)),
+             "v_scale": jnp.zeros((1, 1, 4, 2))}
+    with pytest.raises(ValueError, match="f32 cache"):
+        forward_verify(
+            params, jnp.zeros((1, 2), jnp.int32), cache,
+            jnp.zeros(1, jnp.int32), jnp.zeros(1, jnp.int32),
+            num_heads=HEADS,
+        )
+
+
+# --------------------------------------------------------------------------
+# scheduler level: spec greedy == non-spec greedy, whatever the drafter
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def paged_baseline(params):
+    """Default-workload non-speculative paged run, shared by every test
+    that compares a spec run against it (each baseline is a full engine
+    build + compile — recomputing it per param combo is pure wall)."""
+    return _run(_paged(params))
+
+
+@pytest.mark.parametrize("drafter,kw", [
+    ("truncated", dict(draft_layers=1)),   # shallow: real rejections
+    ("truncated", dict(draft_layers=4)),   # full depth: acceptance 1.0
+    ("int8", dict()),
+])
+def test_spec_greedy_bit_identical_paged(params, paged_baseline, drafter,
+                                         kw):
+    base_tokens, base_rep = paged_baseline
+    eng = _paged(params)
+    sd = SpeculativeDecoder(eng, drafter=drafter, draft_tokens=3, **kw)
+    spec_tokens, rep = _run(eng, spec_decoder=sd)
+    assert spec_tokens == base_tokens
+    assert rep.speculative and rep.drafter == drafter
+    assert rep.draft_tokens == 3
+    assert 0.0 <= rep.acceptance_rate <= 1.0
+    assert rep.tokens_per_verify >= 1.0
+    assert rep.decode_steps <= base_rep.decode_steps
+    if kw.get("draft_layers") == CFG["num_layers"]:
+        # drafter == verifier: every draft is the verifier's own argmax,
+        # and the step count collapses by ~(K+1)x — the amortization the
+        # subsystem exists for
+        assert rep.acceptance_rate == 1.0
+        assert rep.decode_steps <= base_rep.decode_steps / 2
+
+
+def test_spec_greedy_bit_identical_dense(params):
+    """One dense scheduler-level pin (the shallow drafter: real
+    rejections every step); the dense verify math itself is already
+    pinned bitwise at the model level above."""
+    base_tokens, _ = _run(_dense(params))
+    eng = _dense(params)
+    sd = SpeculativeDecoder(eng, drafter="truncated", draft_tokens=3,
+                            draft_layers=1)
+    spec_tokens, rep = _run(eng, spec_decoder=sd)
+    assert spec_tokens == base_tokens
+    assert 0.0 <= rep.acceptance_rate <= 1.0
+
+
+class _CacheScribblingGarbageDrafter(Drafter):
+    """Adversarial drafter: proposes an (almost certainly) wrong token
+    every time AND scribbles real drafter K/V at the draft positions
+    (like a production drafter would) — forcing acceptance 0 so every
+    step exercises the bonus-token path, with rollback required to
+    erase every trace of the writes."""
+
+    name = "garbage-scribble"
+
+    def __init__(self, token: int, layers: int):
+        self.token = token
+        self.layers = layers
+        self._jit = None
+
+    def bind(self, engine):
+        from distributeddeeplearning_tpu.spec.drafter import (
+            TruncatedDrafter,
+        )
+
+        self._inner = TruncatedDrafter(self.layers)
+        self._inner.bind(engine)
+
+    def propose(self, cache, tokens, pos):
+        _, cache = self._inner.propose(cache, tokens, pos)
+        return jnp.full_like(tokens, self.token), cache
+
+
+@pytest.mark.parametrize("layout", ["dense", "paged"])
+def test_forced_rejection_rolls_back_to_never_drafted_state(
+    params, layout
+):
+    """Total rejection is the rollback worst case: every step drafts K
+    tokens, all rejected, one bonus token emitted.  Output must STILL be
+    bit-identical (the bonus IS the greedy token) and the final cache
+    must equal the never-drafted run's cache bit-for-bit — including
+    the zeros where rejected drafts briefly lived.  The scribbling
+    drafter is the stronger adversary (it supersets the write-nothing
+    one): garbage proposals AND garbage K/V written at every draft
+    position, all of which rollback must erase."""
+    build = _dense if layout == "dense" else _paged
+    reqs = _requests(n=2)
+
+    base_eng = build(params, slots=2)
+    base_tokens, _ = _run(base_eng, reqs=[
+        Request(uid=r.uid, prompt=list(r.prompt)) for r in reqs
+    ])
+
+    eng = build(params, slots=2)
+    drafter = _CacheScribblingGarbageDrafter(0, 2)
+    sd = SpeculativeDecoder(eng, drafter=drafter, draft_tokens=3)
+    spec_tokens, rep = _run(eng, spec_decoder=sd, reqs=[
+        Request(uid=r.uid, prompt=list(r.prompt)) for r in reqs
+    ])
+    assert spec_tokens == base_tokens
+    assert rep.acceptance_rate == 0.0
+    assert rep.tokens_per_verify == 1.0  # bonus-only progress
+    # the cache after rollback equals a never-drafted run's, bitwise —
+    # every real page/slot, including pages already released back to the
+    # pool.  The paged scratch page (id 0) is excluded: it is the
+    # designed dustbin for inactive-lane writes and legitimately
+    # accumulates different garbage under different step programs.
+    lo = 1 if layout == "paged" else 0
+    for key in base_eng._cache:
+        np.testing.assert_array_equal(
+            np.asarray(eng._cache[key])[lo:],
+            np.asarray(base_eng._cache[key])[lo:],
+            err_msg=f"{layout}/{key}: rollback left rejected-draft residue",
+        )
+
+
+def test_rollback_equals_scrub_slot(params):
+    """The batched rollback is the jitted form of the host
+    ``scrub_slot(slot, from_pos)`` path — pin the equivalence on a live
+    cache so the two can never drift.  Prompts are bucket-aligned (8 =
+    page_size = prefill_chunk) so no prefill-padding garbage sits beyond
+    the rollback window: rollback zeroes exactly the spec write horizon
+    ``[from_pos, pos+K]`` while scrub_slot zeroes to the end of the
+    slot's pages — equivalent wherever nothing else was ever written,
+    which is the invariant spec rollback runs under."""
+    prompts = {0: list(range(1, 9)), 1: list(range(11, 19))}
+    eng_a = _paged(params, slots=2)
+    eng_b = _paged(params, slots=2)
+    for eng in (eng_a, eng_b):
+        eng.prefill(0, prompts[0], max_new_tokens=8)
+        eng.prefill(1, prompts[1], max_new_tokens=8)
+        # a few decode steps so there is decode-written state to cut
+        toks = np.asarray([1, 2], np.int32)
+        pos = np.asarray([8, 8], np.int32)
+        for _ in range(4):
+            toks = eng.decode(toks, pos)
+            pos = pos + 1
+    sd = SpeculativeDecoder(eng_a, drafter="truncated", draft_layers=1,
+                            draft_tokens=3)
+    # cut slot 0 back to position 10, slot 1 to position 9: rollback
+    # form is keep[i] = from_pos[i] - pos[i]
+    sd.rollback(np.asarray([8, 8], np.int32), np.asarray([2, 1], np.int32))
+    eng_b.scrub_slot(0, 10)
+    eng_b.scrub_slot(1, 9)
+    for key in eng_a._cache:
+        np.testing.assert_array_equal(
+            # scratch page excluded: rollback parks its no-op lanes
+            # there, scrub_slot gathers-and-rewrites it unchanged
+            np.asarray(eng_a._cache[key])[1:],
+            np.asarray(eng_b._cache[key])[1:],
+        )
+
+
+# --------------------------------------------------------------------------
+# scrub_slot(from_pos > 0): partial rollback, both layouts (satellite)
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("layout", ["dense", "paged"])
+def test_scrub_slot_partial_preserves_prefix_bitwise(params, layout):
+    build = _dense if layout == "dense" else _paged
+    eng = build(params, slots=2)
+    prompt = [7, 11, 13, 17, 19, 23]
+    if layout == "dense":
+        _seed_dense_slot(params, eng, 0, prompt)
+    else:
+        eng.prefill(0, prompt, max_new_tokens=12)
+    toks = np.asarray([3, 0], np.int32)
+    pos = np.asarray([len(prompt), 0], np.int32)
+    for _ in range(5):
+        toks = eng.decode(toks, pos)
+        pos = pos + 1
+    before = {k: np.asarray(v).copy() for k, v in eng._cache.items()}
+    from_pos = len(prompt) + 3  # = 9: NOT page-aligned (page_size=8) —
+    # the boundary page holds both preserved and scrubbed positions
+    eng.scrub_slot(0, from_pos)
+    after = {k: np.asarray(v) for k, v in eng._cache.items()}
+
+    def slot_view(tree, key):
+        if layout == "dense":
+            return tree[key][0]  # [L, S, ...]
+        pages = eng._slot_pages[0]
+        return np.concatenate(
+            [tree[key][p] for p in pages], axis=1
+        )  # [L, n*ps, ...]
+
+    for key in before:
+        b, a = slot_view(before, key), slot_view(after, key)
+        np.testing.assert_array_equal(
+            a[:, :from_pos], b[:, :from_pos],
+            err_msg=f"{key}: positions below from_pos were not preserved",
+        )
+        assert not np.any(a[:, from_pos:]), (
+            f"{key}: positions at/above from_pos were not scrubbed"
+        )
+    # other slots untouched
+    if layout == "dense":
+        for key in before:
+            np.testing.assert_array_equal(
+                after[key][1], before[key][1]
+            )
+
+
+def test_scrub_slot_never_writes_prefix_shared_pages(params):
+    """Two slots share prefix pages; scrubbing one slot's decode region
+    must leave the shared pages bit-identical, and a scrub that WOULD
+    reach into the shared region must refuse loudly."""
+    eng = _paged(params, slots=2)
+    shared_prompt = list(range(1, 17))  # two full pages at page_size=8
+    eng.prefill(0, shared_prompt + [21, 22], max_new_tokens=8)
+    eng.prefill(1, shared_prompt + [31, 32], max_new_tokens=8)
+    shared_pages = eng._slot_pages[0][:2]
+    assert shared_pages == eng._slot_pages[1][:2], "prefix hit expected"
+    assert all(eng.allocator.is_shared(p) for p in shared_pages)
+    toks = np.asarray([1, 2], np.int32)
+    pos = np.asarray([18, 18], np.int32)
+    for _ in range(3):
+        toks = eng.decode(toks, pos)
+        pos = pos + 1
+    before = {
+        key: np.asarray(leaf)[shared_pages].copy()
+        for key, leaf in eng._cache.items()
+    }
+    eng.scrub_slot(0, 18)  # the delivery's prompt length
+    for key, leaf in eng._cache.items():
+        np.testing.assert_array_equal(
+            np.asarray(leaf)[shared_pages], before[key],
+            err_msg=f"{key}: scrub touched a prefix-shared page",
+        )
+    with pytest.raises(ValueError, match="prefix-shared"):
+        eng.scrub_slot(0, 3)  # inside the shared prefix: must refuse
+
+
+# --------------------------------------------------------------------------
+# guards, edge cases, report fields
+# --------------------------------------------------------------------------
+
+def test_spec_requires_greedy(params):
+    eng = _paged(params, temperature=0.7)
+    with pytest.raises(ValueError, match="greedy-only"):
+        SpeculativeDecoder(eng, drafter="truncated", draft_layers=1)
+
+
+def test_spec_requires_f32_cache(params):
+    eng = _paged(params, cache_dtype=jnp.int8)
+    with pytest.raises(ValueError, match="f32 KV cache"):
+        SpeculativeDecoder(eng, drafter="truncated", draft_layers=1)
+
+
+def test_spec_rejects_foreign_engine(params):
+    eng_a = _paged(params)
+    eng_b = _paged(params)
+    sd = SpeculativeDecoder(eng_a, drafter="truncated", draft_layers=1)
+    with pytest.raises(ValueError, match="different engine"):
+        ContinuousBatchingScheduler(eng_b, spec_decoder=sd)
+
+
+def test_build_drafter_validation():
+    with pytest.raises(ValueError, match="draft_layers"):
+        build_drafter("truncated")
+    with pytest.raises(ValueError, match="unknown drafter"):
+        build_drafter("telepathy")
+    with pytest.raises(ValueError, match=">= 1"):
+        build_drafter("truncated", draft_layers=0)
+
+
+def test_spec_eos_cut_matches_baseline(params):
+    """An EOS landing mid-draft must cut the committed stream exactly
+    where the non-speculative run stops."""
+    eos = 7
+    reqs = _requests(n=6, seed=4)
+    base_tokens, _ = _run(
+        _paged(params), eos_id=eos, max_new_tokens=12,
+        reqs=[Request(uid=r.uid, prompt=list(r.prompt)) for r in reqs],
+    )
+    eng = _paged(params)
+    sd = SpeculativeDecoder(eng, drafter="truncated", draft_layers=4,
+                            draft_tokens=4)
+    spec_tokens, _ = _run(
+        eng, spec_decoder=sd, eos_id=eos, max_new_tokens=12,
+        reqs=[Request(uid=r.uid, prompt=list(r.prompt)) for r in reqs],
+    )
+    assert spec_tokens == base_tokens
+
+
+def test_spec_budget_one_degenerates_to_plain_decode(params):
+    """budget 1 => draft_len 0 every step: the verify program IS the
+    decode step (bonus token only), still bit-identical."""
+    base_tokens, _ = _run(_paged(params), max_new_tokens=1)
+    eng = _paged(params)
+    sd = SpeculativeDecoder(eng, drafter="truncated", draft_layers=1,
+                            draft_tokens=3)
+    spec_tokens, rep = _run(eng, spec_decoder=sd, max_new_tokens=1)
+    assert spec_tokens == base_tokens
+    assert rep.acceptance_rate is None  # zero drafts proposed
+
+
+def test_spec_quarantine_fails_poisoned_slot_alone(params):
+    faults_mod.install_plan("decode_nan@2")
+    eng = _paged(params, slots=2)
+    sd = SpeculativeDecoder(eng, drafter="truncated", draft_layers=1,
+                            draft_tokens=2)
+    reqs = _requests(n=2, seed=6)
+    tokens, rep = _run(eng, spec_decoder=sd, max_new_tokens=8, reqs=reqs)
+    assert rep.quarantined == 1
+    assert rep.errors == 1
+    # the survivor matches the clean baseline
+    clean_tokens, _ = _run(
+        _paged(params, slots=2), max_new_tokens=8,
+        reqs=_requests(n=2, seed=6),
+    )
+    survivors = [uid for uid in tokens if len(tokens[uid]) == 8]
+    assert survivors
+    for uid in survivors:
+        assert tokens[uid] == clean_tokens[uid]
+
+
+def test_decode_tokens_per_sec_reported(params):
+    """Satellite: decode-phase-only throughput lives next to the
+    whole-wall tokens_per_sec on EVERY run, spec or not."""
+    _, rep = _run(_paged(params))
+    assert rep.decode_tokens_per_sec > 0
+    d = rep.to_dict()
+    assert "decode_tokens_per_sec" in d
+    assert d["speculative"] is False and d["drafter"] is None
+
+    eng = _paged(params)
+    sd = SpeculativeDecoder(eng, drafter="truncated", draft_layers=2,
+                            draft_tokens=3)
+    _, srep = _run(eng, spec_decoder=sd)
+    assert srep.decode_tokens_per_sec > 0
+    assert srep.draft_step_s["p50"] >= 0
+    assert srep.verify_step_s["p99"] >= srep.verify_step_s["p50"]
+    assert srep.verify_step_s["p50"] > 0
+
+
+def test_spec_registry_gauges(params):
+    from distributeddeeplearning_tpu.obs.registry import get_registry
+
+    eng = _paged(params)
+    sd = SpeculativeDecoder(eng, drafter="truncated", draft_layers=4,
+                            draft_tokens=3)
+    _, rep = _run(eng, spec_decoder=sd)
+    reg = get_registry()
+    assert reg.gauge("serve.acceptance_rate").value == rep.acceptance_rate
+    assert reg.gauge("serve.decode_tokens_per_sec").value is not None
+    assert reg.histogram("serve.draft_step_s").count >= rep.decode_steps
+    assert reg.histogram("serve.verify_step_s").count >= rep.decode_steps
+
+
+def test_spec_phase_breakdown(params):
+    """obs.profile.decode_phase_breakdown learns the draft/verify phases
+    and attribute_regression can name an acceptance collapse."""
+    from distributeddeeplearning_tpu.obs.profile import (
+        attribute_regression,
+        decode_phase_breakdown,
+    )
+
+    eng = _paged(params)
+    eng.prefill(0, [1, 2, 3], max_new_tokens=4)
+    sd = SpeculativeDecoder(eng, drafter="truncated", draft_layers=4,
+                            draft_tokens=2)
+    healthy = decode_phase_breakdown(
+        eng, iters=2, warmup=1, spec_decoder=sd
+    )
+    for key in ("draft", "verify"):
+        assert key in healthy["phases_ms"]
+    assert healthy["tokens_per_verify"] >= 1.0
+    assert healthy["ms_per_committed_token"] > 0
+
+    # simulate an acceptance collapse: same costs, tokens_per_verify ~1
+    collapsed = dict(healthy)
+    collapsed["tokens_per_verify"] = 1.0
+    collapsed["ms_per_committed_token"] = healthy["spec_step_ms"]
+    attrib = attribute_regression(healthy, collapsed)
+    assert attrib["hottest_phase"] in collapsed["phases_ms"]
+
+
+# --------------------------------------------------------------------------
+# schema + CLI guards + bench smoke
+# --------------------------------------------------------------------------
+
+def _spec_payload(**over):
+    base = {
+        "metric": "lm_serve_spec_decode_speedup", "value": 1.3,
+        "unit": "x", "bench_revision": 13, "platform": "cpu",
+        "virtual_pod": False, "draft_tokens": 4,
+        "baseline": {"decode_tokens_per_sec": 100.0},
+        "drafters": {
+            "spec_truncated": {
+                "acceptance_rate": 0.9, "tokens_per_verify": 4.2,
+                "decode_tokens_per_sec": 130.0, "bit_identical": True,
+            },
+        },
+        "gates": {"bit_identical": True, "spec_decode_speedup": True},
+    }
+    base.update(over)
+    return base
+
+
+def test_spec_schema_accepts_good_payload():
+    from distributeddeeplearning_tpu.obs.schema import validate_spec_payload
+
+    validate_spec_payload(_spec_payload())
+
+
+@pytest.mark.parametrize("mutation,match", [
+    (dict(drafters={"d": {"acceptance_rate": 1.7,
+                          "tokens_per_verify": 4.0,
+                          "decode_tokens_per_sec": 1.0,
+                          "bit_identical": True}}), "acceptance_rate"),
+    (dict(drafters={"d": {"acceptance_rate": 0.5,
+                          "tokens_per_verify": 0.4,
+                          "decode_tokens_per_sec": 1.0,
+                          "bit_identical": True}}), "tokens_per_verify"),
+    (dict(gates={"bit_identical": True}), "spec_decode_speedup"),
+    (dict(baseline="nope"), "baseline"),
+])
+def test_spec_schema_rejects_bad_payloads(mutation, match):
+    from distributeddeeplearning_tpu.obs.schema import (
+        SchemaError,
+        validate_spec_payload,
+    )
+
+    with pytest.raises(SchemaError, match=match):
+        validate_spec_payload(_spec_payload(**mutation))
+
+
+def test_spec_artifact_file_validated(tmp_path):
+    from distributeddeeplearning_tpu.obs.schema import (
+        SchemaError,
+        validate_artifact,
+    )
+
+    good = tmp_path / "SPEC_r99.json"
+    good.write_text(json.dumps(_spec_payload()))
+    validate_artifact(str(good))
+    bad = tmp_path / "SPEC_r98.json"
+    bad.write_text(json.dumps(_spec_payload(gates={})))
+    with pytest.raises(SchemaError):
+        validate_artifact(str(bad))
+
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_cli_speculative_flag_guards(capsys):
+    """Satellite: --speculative + temperature > 0 errors at CLI-parse
+    time (before any engine build), as do --quantize-kv / --replicas /
+    bad draft knobs.  --dry-run proves no engine was ever constructed."""
+    from distributeddeeplearning_tpu.cli.main import main
+
+    for extra, needle in [
+        (["--temperature", "0.5"], "greedy-only"),
+        (["--quantize-kv", "int8"], "f32 KV cache"),
+        (["--replicas", "2"], "single-replica"),
+        (["--draft-tokens", "0"], "--draft-tokens"),
+        (["--draft-layers", "0"], "--draft-layers"),
+    ]:
+        rc = main(
+            ["serve", "--synthetic", "--speculative", "--dry-run"] + extra
+        )
+        err = capsys.readouterr().err
+        assert rc == 1, extra
+        assert needle in err, (extra, err)
+    # the clean combination dry-runs fine
+    assert main(["serve", "--synthetic", "--speculative", "--dry-run"]) == 0
+
+
+@pytest.mark.timeout(240)
+def test_bench_spec_cpu_smoke(tmp_path):
+    """Fast tier-1 smoke: bench.py --spec end-to-end with a hard
+    --steps-cap so the three-engine comparison can never hang CI."""
+    report = tmp_path / "spec.json"
+    proc = subprocess.run(
+        [
+            sys.executable, "bench.py", "--spec", "--small",
+            "--seq-len", "12", "--serve-requests", "5",
+            "--batch-slots", "2", "--max-new-tokens", "6",
+            "--page-size", "4", "--prefill-chunk", "8",
+            "--draft-tokens", "2", "--draft-layers", "1",
+            "--steps-cap", "60", "--report", str(report),
+        ],
+        capture_output=True, text=True, timeout=220,
+        cwd=str(REPO_ROOT),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert set(line["drafters"]) == {"spec_truncated", "spec_int8"}
+    for d in line["drafters"].values():
+        assert 0.0 <= d["acceptance_rate"] <= 1.0
+        assert d["tokens_per_verify"] >= 1.0
+        assert d["bit_identical"] is True
+    assert line["configs"]["spec_truncated"]["speculative"] is True
+    assert report.exists()
