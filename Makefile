@@ -12,8 +12,8 @@ PROJECT ?= smoke-test-project
 IMAGE ?= ddlt-control
 DATA_DIR ?= /data
 
-.PHONY: install test test-fast generate clean bench-smoke bench scaling dryrun \
-        docker-build docker-run docker-bash docker-stop
+.PHONY: install test test-fast lint generate clean bench-smoke bench scaling \
+        dryrun docker-build docker-run docker-bash docker-stop
 
 install:
 	pip install -e .
@@ -23,6 +23,12 @@ test:
 
 test-fast:
 	python -m pytest tests/ -x -q -m "not slow"
+
+# Static analysis (analysis/): AST hot-loop sync lint + jaxpr/HLO program
+# audits.  Non-zero exit on any unwaived finding (the CLI pins a virtual
+# CPU pod itself, so this works with no TPU attached).
+lint:
+	python -m distributeddeeplearning_tpu.cli.main lint
 
 # Smoke-generate a project non-interactively (reference Makefile:5-16).
 generate:

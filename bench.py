@@ -2211,70 +2211,13 @@ def _run_comms(args) -> int:
     return 0
 
 
-_COLLECTIVE_OPS = (
-    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
-    "all-to-all",
-)
-
-
 def _collective_stats(hlo_text: str):
-    """{op: {count, bytes}} from optimized HLO — the hardware-independent
-    content of a scaling claim: WHICH collectives the compiled program
-    issues per step and how many bytes each moves (output-shape bytes).
+    """Compiled-HLO collective signature — the implementation moved to
+    ``parallel/comms.collective_stats`` so `ddlt lint`'s program audit
+    shares the exact parser the bench artifacts quote."""
+    from distributeddeeplearning_tpu.parallel.comms import collective_stats
 
-    ``-start`` variants count once (their ``-done`` twin carries no new
-    traffic); ``-done`` and region parameter lines are skipped.  An async
-    ``-start``'s tuple signature aliases ``(operands…, results…)``, so
-    only the result half is summed — halving the whole tuple is exact only
-    for equal-size collectives and under-reports all-gather-start /
-    reduce-scatter-start by the axis-size factor (their operand and result
-    differ by exactly that factor).
-    """
-    import re
-
-    bpe = {"f32": 4, "bf16": 2, "s32": 4, "u32": 4, "f16": 2, "u8": 1,
-           "s8": 1, "pred": 1, "f64": 8, "s64": 8, "u64": 8}
-
-    def shape_bytes_list(sig: str):
-        """[(bytes, is_scalar)] per array shape in an HLO signature."""
-        out = []
-        for m in re.finditer(r"(\w+)\[([0-9,]*)\]", sig):
-            if m.group(1) not in bpe:
-                continue
-            n = 1
-            for d in m.group(2).split(","):
-                if d:
-                    n *= int(d)
-            out.append((n * bpe[m.group(1)], not m.group(2)))
-        return out
-
-    stats = {op: {"count": 0, "bytes": 0} for op in _COLLECTIVE_OPS}
-    for line in hlo_text.splitlines():
-        line = line.strip()
-        m = re.match(r"%?\S+ = (\([^)]*\)|\S+) ([\w-]+)\(", line)
-        if not m:
-            continue
-        op = m.group(2)
-        base = op[:-len("-start")] if op.endswith("-start") else op
-        if base not in stats or op.endswith("-done"):
-            continue
-        shapes = shape_bytes_list(m.group(1))
-        if op.endswith("-start") and m.group(1).startswith("("):
-            # (operands…, results…[, context scalars]): the result half is
-            # the moved (output-shape) traffic — exact for unequal-size
-            # collectives like all-gather-start too, where halving the
-            # whole tuple under-reports by the axis-size factor.  u32[]
-            # context scalars are bookkeeping, not traffic.
-            arrays = [b for b, scalar in shapes if not scalar]
-            if arrays and len(arrays) % 2 == 0:
-                nbytes = sum(arrays[len(arrays) // 2:])
-            else:  # odd layout — halving is the best approximation left
-                nbytes = sum(arrays) // 2
-        else:
-            nbytes = sum(b for b, _ in shapes)
-        stats[base]["count"] += 1
-        stats[base]["bytes"] += nbytes
-    return {op: s for op, s in stats.items() if s["count"]}
+    return collective_stats(hlo_text)
 
 
 def _run_scaling(args) -> int:
@@ -2402,6 +2345,12 @@ def main() -> int:
     parser.add_argument("--num-warmup", type=int, default=10)
     parser.add_argument(
         "--small", action="store_true", help="tiny shapes for CI smoke"
+    )
+    parser.add_argument(
+        "--lint", action="store_true",
+        help="preflight: run `ddlt lint` (both analyzer layers) and abort "
+        "before benchmarking if the tree has open findings — committed "
+        "artifacts can then never come from a dirty tree",
     )
     parser.add_argument(
         "--scan-unroll", type=int, default=1,
@@ -2813,6 +2762,39 @@ def main() -> int:
             )
             return 1
     enable_compilation_cache()
+    if args.lint:
+        # preflight: a committed artifact must never be produced from a
+        # tree with open findings — run both analyzer layers and abort
+        # BEFORE any benchmark phase when anything is open
+        from distributeddeeplearning_tpu.analysis import (
+            format_findings,
+            run_lint,
+        )
+
+        findings = run_lint()
+        if findings:
+            print(format_findings(findings), file=sys.stderr)
+            print(
+                "[bench] --lint preflight FAILED: refusing to benchmark a "
+                "tree with open findings",
+                file=sys.stderr,
+            )
+            return 1
+        # a clean result must not read stronger than it is: audits the
+        # current backend could not run (e.g. the implicit collective
+        # check on a 1-device box) are reported, not swallowed
+        from distributeddeeplearning_tpu.analysis.program_audit import (
+            skipped_audits,
+        )
+
+        skips = skipped_audits()
+        for note in skips:
+            print(f"[bench] --lint preflight SKIPPED {note}", file=sys.stderr)
+        print(
+            "[bench] --lint preflight: 0 findings"
+            + (f" ({len(skips)} audit(s) skipped)" if skips else ""),
+            file=sys.stderr,
+        )
     if args.faults:
         return _run_faults(args)
     if args.serve_faults:

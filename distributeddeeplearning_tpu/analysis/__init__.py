@@ -1,0 +1,27 @@
+"""``ddlt lint`` — the static-analysis subsystem.
+
+Two layers over one registry:
+
+- **Layer 1 (AST)**: the hot-region host-sync checker
+  (``analysis/host_sync.py``) over the declarative region registry
+  (``analysis/regions.py``) — import-alias-resolved banned calls,
+  ``# sync-ok`` waivers with stale-marker detection and exact designed-
+  sync budgets — plus the fault-coverage cross-check
+  (``analysis/fault_coverage.py``).
+- **Layer 2 (jaxpr/HLO)**: ``analysis/program_audit.py`` traces the
+  registered jitted programs on abstract shapes and pins donation,
+  collective signatures, the int8-history dtype audit and sharding
+  coverage.
+
+``run_lint()`` is the everything entry point (CLI ``ddlt lint``,
+``bench.py --lint``, tier-1's clean-tree test); findings format as
+``path:line: [checker] message`` with a fix hint.
+"""
+
+from distributeddeeplearning_tpu.analysis.core import (
+    Finding,
+    format_findings,
+    run_lint,
+)
+
+__all__ = ["Finding", "format_findings", "run_lint"]

@@ -1,0 +1,81 @@
+"""Finding type + the ``ddlt lint`` driver.
+
+A finding is one violated structural invariant, anchored to a file:line so
+the operator can jump straight to it, with a fix hint that says what the
+*invariant* wants (not just what the checker saw).  ``run_lint`` is the
+single entry point the CLI, ``bench.py --lint`` and the tier-1 tests all
+share — zero findings on a clean tree is itself a pinned test, so every
+checker must hold its false-positive rate at literally zero.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violated invariant.
+
+    ``checker`` names the invariant class (``host-sync``, ``stale-marker``,
+    ``landmark``, ``allowlist-budget``, ``callback-in-jit``, ``donation``,
+    ``collective-signature``, ``dtype-audit``, ``sharding-coverage``,
+    ``fault-coverage``); ``path``/``line`` anchor it (line 0 = whole file /
+    whole program); ``hint`` is the one-line fix direction.
+    """
+
+    checker: str
+    path: str
+    line: int
+    message: str
+    hint: str = ""
+
+    def format(self, root: Optional[str] = None) -> str:
+        path = self.path
+        if root:
+            try:
+                rel = os.path.relpath(path, root)
+                if not rel.startswith(".."):
+                    path = rel
+            except ValueError:
+                pass
+        out = f"{path}:{self.line}: [{self.checker}] {self.message}"
+        if self.hint:
+            out += f"\n    fix: {self.hint}"
+        return out
+
+
+def format_findings(findings: List[Finding], root: Optional[str] = None) -> str:
+    if not findings:
+        return "ddlt lint: 0 findings"
+    lines = [f.format(root) for f in findings]
+    lines.append(f"ddlt lint: {len(findings)} finding(s)")
+    return "\n".join(lines)
+
+
+def run_lint(*, programs: bool = True) -> List[Finding]:
+    """Run every registered checker over the live tree.
+
+    Layer 1 (AST — cheap, no jax): the hot-region host-sync checker over
+    ``regions.ALL_REGIONS`` and the fault-coverage cross-check.  Layer 2
+    (``programs=True``): the jaxpr/HLO program audits — traces the
+    registered jitted programs on abstract shapes (imports jax; run under
+    ``JAX_PLATFORMS=cpu`` with a virtual pod for the collective checks).
+    """
+    from distributeddeeplearning_tpu.analysis import (
+        fault_coverage,
+        host_sync,
+        regions,
+    )
+
+    findings: List[Finding] = []
+    for region in regions.ALL_REGIONS:
+        findings.extend(host_sync.check_region(region))
+    findings.extend(fault_coverage.check_fault_coverage())
+    if programs:
+        from distributeddeeplearning_tpu.analysis import program_audit
+
+        findings.extend(program_audit.run_program_audits())
+    return findings

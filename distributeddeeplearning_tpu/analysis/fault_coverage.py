@@ -1,0 +1,182 @@
+"""Static fault-coverage cross-check: every declared fault kind is wired.
+
+``utils/faults.py`` declares the chaos vocabulary (``KINDS``) and the
+``FaultPlan`` hook methods that fire each kind.  A kind whose injection
+call-site was renamed away (or never wired) silently removes that failure
+mode from every chaos bench — the resilience layer's oldest bug class
+(SURVEY §5: the reference's resume protocol was dead code).  This pass is
+grep-free: it AST-parses the faults module for the declared kinds and the
+hook methods, then AST-walks the package for *call* sites of those hooks
+(strings, comments and mere attribute mentions don't count), and fails any
+kind with zero call-sites.
+
+The kind->hook mapping is declared here (``KIND_HOOKS``) rather than
+inferred, and is itself cross-checked both ways: a kind missing from the
+mapping and a mapping naming a hook ``FaultPlan`` no longer defines are
+findings too — so a rename anywhere in the chain surfaces.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from distributeddeeplearning_tpu.analysis.core import Finding
+from distributeddeeplearning_tpu.analysis.host_sync import module_path
+
+FAULTS_MODULE = "distributeddeeplearning_tpu.utils.faults"
+
+#: fault kind -> FaultPlan hook method(s) whose call-site injects it.
+#: ``has_decode_nan`` is the non-consuming peek; the consuming
+#: ``take_decode_nan`` is the injection and is what coverage requires.
+KIND_HOOKS: Dict[str, Tuple[str, ...]] = {
+    "nan_loss": ("poison_batch",),
+    "data_stall": ("wrap_data",),
+    "data_death": ("wrap_data",),
+    "preempt": ("maybe_preempt",),
+    "io_error": ("maybe_io_error",),
+    "replica_death": ("take_replica_death",),
+    "decode_nan": ("take_decode_nan",),
+    "decode_stall": ("take_decode_stall",),
+    "reject_admit": ("maybe_reject_admit",),
+}
+
+
+def _parse_faults(path: str):
+    """(kinds, kinds_lineno, plan_methods) from the faults module AST."""
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    kinds: Tuple[str, ...] = ()
+    kinds_line = 0
+    methods: set = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "KINDS":
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        kinds = tuple(
+                            e.value
+                            for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                        )
+                        kinds_line = node.lineno
+        elif isinstance(node, ast.ClassDef) and node.name == "FaultPlan":
+            methods = {
+                n.name
+                for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+    return kinds, kinds_line, methods
+
+
+def _call_sites(
+    package_root: str, hook_names: Sequence[str], skip_paths: Sequence[str]
+) -> Dict[str, List[Tuple[str, int]]]:
+    """hook name -> [(path, line)] of ``<expr>.<hook>(...)`` call sites
+    across the package (AST-resolved: only Call nodes count)."""
+    wanted = set(hook_names)
+    sites: Dict[str, List[Tuple[str, int]]] = {h: [] for h in wanted}
+    skip = {os.path.abspath(p) for p in skip_paths}
+    for dirpath, dirnames, filenames in os.walk(package_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            if os.path.abspath(path) in skip:
+                continue
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in wanted
+                ):
+                    sites[node.func.attr].append((path, node.lineno))
+    return sites
+
+
+def check_fault_coverage(
+    *,
+    faults_path: Optional[str] = None,
+    package_root: Optional[str] = None,
+    kind_hooks: Optional[Dict[str, Tuple[str, ...]]] = None,
+) -> List[Finding]:
+    """Cross-check declared kinds against live injection call-sites.
+
+    The keyword overrides exist for the seeded-violation fixture corpus;
+    the defaults audit the real package.
+    """
+    faults_path = faults_path or module_path(FAULTS_MODULE)
+    if package_root is None:
+        package_root = os.path.dirname(os.path.dirname(faults_path))
+    kind_hooks = KIND_HOOKS if kind_hooks is None else kind_hooks
+
+    findings: List[Finding] = []
+    kinds, kinds_line, plan_methods = _parse_faults(faults_path)
+    if not kinds:
+        return [
+            Finding(
+                "fault-coverage", faults_path, 0,
+                "could not parse the KINDS tuple from the faults module",
+                hint="keep KINDS a module-level tuple of string literals",
+            )
+        ]
+
+    for kind in kinds:
+        if kind not in kind_hooks:
+            findings.append(
+                Finding(
+                    "fault-coverage", faults_path, kinds_line,
+                    f"fault kind {kind!r} has no declared injection hook",
+                    hint="add the kind -> FaultPlan hook mapping to "
+                    "analysis/fault_coverage.KIND_HOOKS",
+                )
+            )
+    for kind, hooks in kind_hooks.items():
+        if kind not in kinds:
+            findings.append(
+                Finding(
+                    "fault-coverage", faults_path, kinds_line,
+                    f"KIND_HOOKS maps {kind!r} but the faults module no "
+                    "longer declares that kind",
+                    hint="drop the stale mapping (or restore the kind)",
+                )
+            )
+            continue
+        for hook in hooks:
+            if hook not in plan_methods:
+                findings.append(
+                    Finding(
+                        "fault-coverage", faults_path, kinds_line,
+                        f"hook {hook!r} for fault kind {kind!r} is not a "
+                        "FaultPlan method (renamed?)",
+                        hint="follow the rename in KIND_HOOKS — a stale "
+                        "hook name silently disables that chaos coverage",
+                    )
+                )
+
+    all_hooks = sorted({h for hooks in kind_hooks.values() for h in hooks})
+    sites = _call_sites(package_root, all_hooks, skip_paths=[faults_path])
+    for kind in kinds:
+        hooks = kind_hooks.get(kind)
+        if not hooks:
+            continue  # already reported above
+        if not any(sites.get(h) for h in hooks):
+            findings.append(
+                Finding(
+                    "fault-coverage", faults_path, kinds_line,
+                    f"fault kind {kind!r} is declared but has no injection "
+                    f"call-site in the package (hooks: {', '.join(hooks)})",
+                    hint="wire plan.<hook>() at the subsystem's injection "
+                    "point, or drop the kind — an uninjectable fault is "
+                    "untested recovery code",
+                )
+            )
+    return findings

@@ -1,0 +1,362 @@
+"""AST host-sync checker: the hot-loop lint as a real analyzer.
+
+The invariant (ROADMAP "r01 per-step ``float()`` cost ~2x"): a dispatch
+hot loop never blocks on device values — the banned operations are the
+host-coercion calls that force a device round-trip per step:
+
+- ``float(x)`` on a device scalar;
+- ``x.item()``;
+- ``numpy.asarray(x)`` — resolved through the module's imports, so
+  ``import numpy as np`` / ``as xp`` / ``from numpy import asarray as aa``
+  all canonicalize to the same target, while ``jax.numpy.asarray`` (a
+  host->device *upload*, dispatch-only) never false-positives whatever it
+  is locally called;
+- ``jax.device_get`` (again import-resolved, plus any attribute call
+  literally named ``device_get``).
+
+Being an AST pass, strings and comments are structurally invisible (the
+regex predecessor flagged ``"float("`` inside docstrings), and a call is
+a call whatever the line wraps to.
+
+Waivers: a line carrying a ``# sync-ok: <why>`` marker (the colon makes
+the justification mandatory) is a *designed* sync — waived, counted
+against the region's ``sync_budget``.  A marker on a line the checker no
+longer flags is itself a **stale-marker** finding: dead waivers are how
+an allowlist quietly becomes a pile of lies.  Banned targets passed as
+bare references (``map(np.asarray, outs)``, ``tree_map(jax.device_get,
+t)``) are flagged too — they sync per element without a direct Call node.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from distributeddeeplearning_tpu.analysis.core import Finding
+from distributeddeeplearning_tpu.analysis.regions import HotRegion
+
+#: a waiver is a comment that BEGINS with ``sync-ok:`` — the colon makes
+#: the justification mandatory AND keeps prose comments that merely
+#: mention the marker (lint documentation) from becoming phantom waivers
+MARKER_RE = re.compile(r"#\s*sync-ok:")
+
+#: import-canonicalized call targets that read a device value back
+BANNED_CANONICAL: Dict[str, str] = {
+    "numpy.asarray": "np.asarray readback",
+    "jax.device_get": "jax.device_get",
+}
+#: zero-arg method calls that read a device value back
+BANNED_METHODS = ("item",)
+#: attribute calls banned by their final name regardless of resolution
+#: (``anything.device_get(...)`` is a readback wherever it came from)
+BANNED_ATTR_ANY_BASE = ("device_get",)
+#: targets banned even as bare *references* (``tree_map(jax.device_get,
+#: t)`` / ``map(np.asarray, outs)`` sync without a direct Call node —
+#: the regex predecessor caught these as substrings, so the AST checker
+#: must not narrow detection here); ``float`` is deliberately excluded
+#: (type references like ``isinstance(x, float)`` are everywhere)
+BANNED_REFERENCE_TARGETS = ("numpy.asarray", "jax.device_get")
+
+
+class RegionError(Exception):
+    """The registry entry no longer matches the source (function or loop
+    moved/renamed) — surfaced as a finding, not a crash."""
+
+
+def _import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """local name -> canonical dotted target, from every import statement
+    in the module (module level and nested — a function-local
+    ``import numpy as xp`` must not evade the checker)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+                else:
+                    head = a.name.split(".")[0]
+                    aliases[head] = head
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _dotted(node: ast.expr) -> Optional[List[str]]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def _canonical(parts: Sequence[str], aliases: Dict[str, str]) -> str:
+    head = aliases.get(parts[0], parts[0])
+    return ".".join([head] + list(parts[1:]))
+
+
+def classify_call(
+    node: ast.Call, aliases: Dict[str, str]
+) -> Optional[str]:
+    """Human-readable description of the banned sync this call performs,
+    or None when the call is clean."""
+    func = node.func
+    if isinstance(func, ast.Name) and func.id == "float" and node.args:
+        return "float(...)"
+    if isinstance(func, ast.Attribute):
+        if func.attr in BANNED_METHODS and not node.args and not node.keywords:
+            return f".{func.attr}()"
+        if func.attr in BANNED_ATTR_ANY_BASE:
+            return f".{func.attr}(...)"
+    parts = _dotted(func)
+    if parts:
+        canon = _canonical(parts, aliases)
+        for target, label in BANNED_CANONICAL.items():
+            if canon == target or canon.startswith(target + "."):
+                spelled = ".".join(parts)
+                return (
+                    f"{spelled}(...) [-> {target}]"
+                    if spelled != target else f"{target}(...)"
+                )
+    return None
+
+
+def classify_reference(
+    node: ast.expr, aliases: Dict[str, str]
+) -> Optional[str]:
+    """Banned sync target used as a bare function *reference* (an
+    argument to ``map``/``tree_map``/``sorted(key=...)`` etc.) — it will
+    be called per element, syncing just as hard as a direct call."""
+    if not isinstance(node, (ast.Name, ast.Attribute)):
+        return None
+    if not isinstance(getattr(node, "ctx", None), ast.Load):
+        return None
+    parts = _dotted(node)
+    if not parts:
+        return None
+    if len(parts) > 1 and parts[-1] in BANNED_ATTR_ANY_BASE:
+        return f".{parts[-1]} reference"
+    canon = _canonical(parts, aliases)
+    for target in BANNED_REFERENCE_TARGETS:
+        if canon == target or canon.startswith(target + "."):
+            spelled = ".".join(parts)
+            return (
+                f"{spelled} [-> {target}] reference"
+                if spelled != target else f"{target} reference"
+            )
+    return None
+
+
+def _find_def(
+    tree: ast.Module, qualpath: Sequence[str]
+) -> ast.FunctionDef:
+    """Resolve ``Class.method`` / ``function`` to its def node."""
+    scope: Sequence[ast.stmt] = tree.body
+    node: Optional[ast.AST] = None
+    for name in qualpath:
+        node = next(
+            (
+                n
+                for n in scope
+                if isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                )
+                and n.name == name
+            ),
+            None,
+        )
+        if node is None:
+            raise RegionError(f"def {'.'.join(qualpath)} not found")
+        scope = node.body
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise RegionError(f"{'.'.join(qualpath)} is not a function")
+    return node
+
+
+def _locate_body(
+    fn: ast.FunctionDef, locator: Optional[str], lines: Sequence[str]
+) -> List[ast.stmt]:
+    if locator is None:
+        return list(fn.body)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            # header may wrap; scan from the header line to the first body
+            # statement (exclusive) for the locator substring
+            stop = node.body[0].lineno if node.body else node.lineno + 1
+            header = "\n".join(lines[node.lineno - 1 : stop - 1]) or lines[
+                node.lineno - 1
+            ]
+            if locator in header:
+                return list(node.body)
+    raise RegionError(f"no loop matching locator {locator!r}")
+
+
+def analyze_source(
+    source: str, path: str, region: HotRegion
+) -> List[Finding]:
+    """Run the host-sync checker for ``region`` over module ``source``.
+
+    Pure (no imports of the target): the unit the fixture corpus drives.
+    """
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return [
+            Finding(
+                "host-sync", path, exc.lineno or 0,
+                f"region {region.name}: module does not parse: {exc.msg}",
+            )
+        ]
+    aliases = _import_aliases(tree)
+    try:
+        fn = _find_def(tree, region.qualname.split("."))
+        body = _locate_body(fn, region.locator, lines)
+    except RegionError as exc:
+        return [
+            Finding(
+                "region", path, 0,
+                f"hot region {region.name}: {exc} — the registry entry no "
+                "longer matches the source",
+                hint="update the locator/qualname in analysis/regions.py "
+                "to follow the refactor (the lint must keep scanning the "
+                "real hot loop)",
+            )
+        ]
+    if not body:
+        return [
+            Finding(
+                "region", path, fn.lineno,
+                f"hot region {region.name} resolved to an empty body",
+            )
+        ]
+    start = body[0].lineno
+    end = max(getattr(s, "end_lineno", s.lineno) for s in body)
+    region_src = "\n".join(lines[start - 1 : end])
+
+    findings: List[Finding] = []
+    for landmark in region.landmarks:
+        if landmark not in region_src:
+            findings.append(
+                Finding(
+                    "landmark", path, start,
+                    f"hot region {region.name} lost its landmark "
+                    f"{landmark!r} — either the lint is scanning the wrong "
+                    "region or load-bearing instrumentation was removed",
+                    hint="restore the landmark (e.g. the obs span / the "
+                    "dispatch call) or update analysis/regions.py if the "
+                    "design moved it",
+                )
+            )
+
+    # sync sites -----------------------------------------------------------
+    sites: List[Tuple[int, int, str, bool]] = []  # (line, end, call, marked)
+    call_funcs = set()  # func nodes of Calls: classified there, not as refs
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                call_funcs.add(id(node.func))
+
+    def add_site(node: ast.AST, label: str) -> None:
+        lo = node.lineno
+        hi = getattr(node, "end_lineno", lo)
+        marked = any(
+            MARKER_RE.search(lines[ln - 1])
+            for ln in range(lo, min(hi, len(lines)) + 1)
+        )
+        sites.append((lo, hi, label, marked))
+
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                call = classify_call(node, aliases)
+                if call is not None:
+                    add_site(node, call)
+            elif id(node) not in call_funcs:
+                ref = classify_reference(node, aliases)
+                if ref is not None:
+                    add_site(node, ref)
+
+    live_marker_lines = set()
+    for lo, hi, call, marked in sites:
+        if marked and region.honor_markers:
+            for ln in range(lo, hi + 1):
+                if MARKER_RE.search(lines[ln - 1]):
+                    live_marker_lines.add(ln)
+            continue
+        findings.append(
+            Finding(
+                "host-sync", path, lo,
+                f"per-step host sync `{call}` in hot region {region.name}"
+                + ("" if region.honor_markers else " (jitted builder: "
+                   "markers are not honored here)"),
+                hint=(
+                    "move it out of the hot loop (log-interval / end-of-run "
+                    "block), or if it is a deliberate documented price tag "
+                    "the line '# sync-ok: <why>' AND bump the region's "
+                    "sync_budget in analysis/regions.py"
+                    if region.honor_markers
+                    else "host coercions cannot live inside a jitted "
+                    "program — hoist the readback to the caller"
+                ),
+            )
+        )
+
+    # stale markers --------------------------------------------------------
+    marker_lines = [
+        ln
+        for ln in range(start, end + 1)
+        if ln <= len(lines) and MARKER_RE.search(lines[ln - 1])
+    ]
+    for ln in marker_lines:
+        covered = any(lo <= ln <= hi for lo, hi, _, _ in sites)
+        if covered:
+            # live waiver (honored regions) or already reported as a
+            # host-sync finding (strict regions) — either way not stale
+            continue
+        findings.append(
+            Finding(
+                "stale-marker", path, ln,
+                f"'# sync-ok' marker on a line the checker no longer flags "
+                f"in region {region.name}",
+                hint="delete the marker — dead waivers rot the allowlist "
+                "(if the sync moved, the marker moves with it)",
+            )
+        )
+
+    # designed-sync budget -------------------------------------------------
+    if region.honor_markers and len(live_marker_lines) != region.sync_budget:
+        findings.append(
+            Finding(
+                "allowlist-budget", path, start,
+                f"hot region {region.name} expects exactly "
+                f"{region.sync_budget} designed-sync (sync-ok) line(s), "
+                f"found {len(live_marker_lines)} — the lint may be scanning "
+                "the wrong region, or the design changed",
+                hint="fix the region locator, or update sync_budget in "
+                "analysis/regions.py alongside the reviewed design change",
+            )
+        )
+    return findings
+
+
+def module_path(module: str) -> str:
+    spec = importlib.util.find_spec(module)
+    if spec is None or not spec.origin:
+        raise ImportError(f"cannot locate module {module}")
+    return spec.origin
+
+
+def check_region(
+    region: HotRegion, *, path: Optional[str] = None
+) -> List[Finding]:
+    """Analyze ``region`` against its live source file (or ``path``)."""
+    src_path = path or module_path(region.module)
+    with open(src_path, "r", encoding="utf-8") as f:
+        source = f.read()
+    return analyze_source(source, src_path, region)

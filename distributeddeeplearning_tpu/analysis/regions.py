@@ -1,0 +1,152 @@
+"""The hot-region registry: WHERE the dispatch-pipelining invariants live.
+
+Every entry names a function whose body (or one loop inside it) must stay
+free of per-step host syncs.  The old lint located these regions by
+indentation-scraping ``inspect.getsource`` and grepping a regex — fragile
+to reformatting, blind to import aliasing, and happy to flag ``float(``
+inside a string.  The registry + AST checker (``analysis/host_sync.py``)
+replace that: each region declares
+
+- a **locator**: a substring of the loop-header line (``None`` = the whole
+  function body is the region — e.g. ``SpeculativeDecoder.step``, which IS
+  the draft->verify loop);
+- **landmarks**: substrings that must appear in the region's source — the
+  right-region guard (a refactor that moves the loop leaves the locator
+  matching some other loop) doubled as the instrumentation guard (the obs
+  spans inside the hot loops are load-bearing: the timeline is built from
+  them, and the sync lint alone would not notice them vanishing);
+- a **sync_budget**: the number of *designed* host syncs — lines carrying
+  a live ``# sync-ok: <why>`` marker.  Exact, not a floor: waiving a NEW sync
+  means editing this registry, which is a reviewed change, and a marked
+  line that stops syncing is a stale-marker finding (dead waivers rot the
+  allowlist's story);
+- ``honor_markers=False`` for the jitted step builders: inside jit a host
+  sync is a bug, full stop — there is no designed-sync story to waive
+  into, so markers neither waive nor count there.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class HotRegion:
+    name: str
+    module: str
+    qualname: str
+    locator: Optional[str] = None
+    landmarks: Tuple[str, ...] = ()
+    sync_budget: int = 0
+    honor_markers: bool = True
+
+
+#: The dispatch hot loops — one designed-sync budget each.
+HOT_REGIONS: Tuple[HotRegion, ...] = (
+    HotRegion(
+        name="trainer-step-loop",
+        module="distributeddeeplearning_tpu.train.loop",
+        qualname="Trainer._fit_inner",
+        locator="for step_i in range",
+        landmarks=("self.train_step(", "trace.span("),
+        # the anomaly detector's documented one-sync-per-step price:
+        # loss, grad_norm and the anomalous flag read on three marked lines
+        sync_budget=3,
+    ),
+    HotRegion(
+        name="serve-decode-loop",
+        module="distributeddeeplearning_tpu.serve.scheduler",
+        qualname="ContinuousBatchingScheduler.run",
+        locator="while pending or active",
+        # the ONE designed sync is the token readback inside engine.decode
+        # (not in this region's source), so the loop body itself budgets 0
+        landmarks=("engine.decode(", "trace.span("),
+        sync_budget=0,
+    ),
+    HotRegion(
+        name="fleet-dispatch-loop",
+        module="distributeddeeplearning_tpu.serve.fleet",
+        qualname="FleetRouter.serve",
+        locator="while len(results) < len(flights)",
+        # pure host bookkeeping by design: device values never cross the
+        # process boundary, so ANY sync token here is a leak
+        landmarks=("self._outbox.get", "handle_death"),
+        sync_budget=0,
+    ),
+    HotRegion(
+        name="spec-draft-verify-loop",
+        module="distributeddeeplearning_tpu.spec.decode",
+        qualname="SpeculativeDecoder.step",
+        locator=None,  # the whole method IS the draft->verify loop
+        landmarks=("drafter.propose", "self._verify_jit"),
+        # the one designed readback: committed tokens + acceptance +
+        # finiteness ride a single sync across three marked lines
+        sync_budget=3,
+    ),
+)
+
+#: Jitted step builders: no host-sync token at all — inside jit it would
+#: either crash or silently fall back to host math; markers don't waive.
+JIT_BUILDER_REGIONS: Tuple[HotRegion, ...] = (
+    HotRegion(
+        name="train-step-builder",
+        module="distributeddeeplearning_tpu.train.step",
+        qualname="build_train_step",
+        honor_markers=False,
+    ),
+    HotRegion(
+        name="comm-overlap-step-builder",
+        module="distributeddeeplearning_tpu.train.step",
+        qualname="_build_comm_overlap_step",
+        honor_markers=False,
+    ),
+    HotRegion(
+        name="eval-step-builder",
+        module="distributeddeeplearning_tpu.train.step",
+        qualname="build_eval_step",
+        honor_markers=False,
+    ),
+)
+
+#: The obs hot API lives INSIDE both hot loops (spans around every step),
+#: so it gets the same treatment; its two documented host-scalar
+#: coercions are marked and budgeted.
+_OBS_TRACE = "distributeddeeplearning_tpu.obs.trace"
+_OBS_REG = "distributeddeeplearning_tpu.obs.registry"
+OBS_HOT_REGIONS: Tuple[HotRegion, ...] = (
+    HotRegion(name="obs-tracer-span", module=_OBS_TRACE, qualname="Tracer.span"),
+    HotRegion(name="obs-tracer-event", module=_OBS_TRACE, qualname="Tracer.event"),
+    HotRegion(name="obs-span-enter", module=_OBS_TRACE, qualname="_Span.__enter__"),
+    HotRegion(name="obs-span-exit", module=_OBS_TRACE, qualname="_Span.__exit__"),
+    HotRegion(
+        name="obs-nullspan-enter", module=_OBS_TRACE, qualname="_NullSpan.__enter__"
+    ),
+    HotRegion(
+        name="obs-nullspan-exit", module=_OBS_TRACE, qualname="_NullSpan.__exit__"
+    ),
+    HotRegion(
+        name="obs-histogram-record",
+        module=_OBS_REG,
+        qualname="Histogram.record",
+        sync_budget=1,  # the documented host-scalar coercion
+    ),
+    HotRegion(name="obs-counter-inc", module=_OBS_REG, qualname="Counter.inc"),
+    HotRegion(
+        name="obs-gauge-set",
+        module=_OBS_REG,
+        qualname="Gauge.set",
+        sync_budget=1,  # the documented host-scalar coercion
+    ),
+)
+
+ALL_REGIONS: Tuple[HotRegion, ...] = (
+    HOT_REGIONS + JIT_BUILDER_REGIONS + OBS_HOT_REGIONS
+)
+
+
+def get_region(name: str) -> HotRegion:
+    for region in ALL_REGIONS:
+        if region.name == name:
+            return region
+    raise KeyError(f"unknown hot region {name!r}")

@@ -559,6 +559,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="With --run: print only the metrics JSONL rows (old behavior)",
     )
 
+    lint_p = sub.add_parser(
+        "lint",
+        help="Static analysis over the hot-loop / program invariants "
+        "(analysis/): AST host-sync checker over the hot-region registry "
+        "+ jaxpr/HLO program audits (donation, collective signature, int8 "
+        "dtype audit, sharding coverage, fault coverage).  Exits non-zero "
+        "on any unwaived finding.",
+    )
+    lint_p.add_argument(
+        "--no-programs", action="store_true",
+        help="AST layer only — skip the jaxpr/HLO program audits "
+        "(no backend init or tracing; seconds instead of tens of "
+        "seconds)",
+    )
+    lint_p.add_argument(
+        "--json", action="store_true",
+        help="machine-readable findings (list of objects) on stdout",
+    )
+
     sub.add_parser("experiments", help="List experiments in the run registry")
 
     new_p = sub.add_parser("new", help="Generate a new project scaffold")
@@ -823,6 +842,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             GcsStorage(runner, bucket=cfg.get("GCS_BUCKET")).delete_bucket()
         return 0
 
+    if args.command == "lint":
+        return _cmd_lint(args)
     if args.command == "tpu":
         return _cmd_tpu(args)
     if args.command == "train":
@@ -941,6 +962,54 @@ def _read_text_maybe_gs(path: str):
 
     p = _Path(path)
     return p.read_text() if p.exists() else None
+
+
+def _cmd_lint(args) -> int:
+    """``ddlt lint``: run both analyzer layers, print findings with
+    file:line + fix hint, exit non-zero on any unwaived finding."""
+    import dataclasses as _dc
+    import json as _json
+    import os
+
+    if not args.no_programs:
+        # the program audits trace on abstract shapes — request an
+        # 8-device virtual CPU pod BEFORE the first backend query (the
+        # collective-signature checks need real data shards, and no
+        # hardware plugin must ever be touched), then flip the platform
+        # through the SHARED virtual-pod recipe: env vars alone are not
+        # enough where a hardware PJRT plugin pins JAX_PLATFORMS at
+        # interpreter startup (see tests/conftest.py).  If a backend is
+        # already live the flip is a no-op and any device-count-gated
+        # audit that cannot run is reported below, not swallowed.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        from distributeddeeplearning_tpu.utils.virtual_pod import (
+            force_cpu_platform_if_virtual_pod,
+        )
+
+        force_cpu_platform_if_virtual_pod()
+    from distributeddeeplearning_tpu.analysis import (
+        format_findings,
+        run_lint,
+    )
+
+    findings = run_lint(programs=not args.no_programs)
+    if not args.no_programs:
+        from distributeddeeplearning_tpu.analysis.program_audit import (
+            skipped_audits,
+        )
+
+        for note in skipped_audits():
+            print(f"ddlt lint: SKIPPED {note}", file=sys.stderr)
+    if args.json:
+        print(_json.dumps([_dc.asdict(f) for f in findings], indent=2))
+    else:
+        print(format_findings(findings, os.getcwd()))
+    return 1 if findings else 0
 
 
 def _cmd_setup(args) -> int:

@@ -9,7 +9,8 @@ Chrome-trace JSON (``chrome://tracing`` / Perfetto open it directly), with
 ``jax.profiler.TraceAnnotation`` pass-through so the same span names land
 inside the device profile and :mod:`.profile` can merge the two timelines.
 
-Design constraints (enforced by ``tests/test_hotloop_lint.py``):
+Design constraints (enforced by the ``analysis/`` host-sync checker via
+``tests/test_hotloop_lint.py``):
 
 - **zero-sync**: nothing in the span path reads a device value — spans
   time host wall-clock only, so instrumenting a hot loop can never

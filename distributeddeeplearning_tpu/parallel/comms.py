@@ -350,3 +350,74 @@ def ring_wire_bytes(
         "total_bytes": rs + ag,
         "implicit_allreduce_bytes": baseline,
     }
+
+
+# --------------------------------------------------------------------------
+# Compiled-HLO collective signature (shared by bench.py and `ddlt lint`'s
+# program audit — the hardware-independent content of a scaling claim).
+# --------------------------------------------------------------------------
+
+COLLECTIVE_OPS = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+)
+
+
+def collective_stats(hlo_text: str):
+    """{op: {count, bytes}} from optimized HLO — WHICH collectives the
+    compiled program issues per step and how many bytes each moves
+    (output-shape bytes).
+
+    ``-start`` variants count once (their ``-done`` twin carries no new
+    traffic); ``-done`` and region parameter lines are skipped.  An async
+    ``-start``'s tuple signature aliases ``(operands…, results…)``, so
+    only the result half is summed — halving the whole tuple is exact only
+    for equal-size collectives and under-reports all-gather-start /
+    reduce-scatter-start by the axis-size factor (their operand and result
+    differ by exactly that factor).
+    """
+    import re
+
+    bpe = {"f32": 4, "bf16": 2, "s32": 4, "u32": 4, "f16": 2, "u8": 1,
+           "s8": 1, "pred": 1, "f64": 8, "s64": 8, "u64": 8}
+
+    def shape_bytes_list(sig: str):
+        """[(bytes, is_scalar)] per array shape in an HLO signature."""
+        out = []
+        for m in re.finditer(r"(\w+)\[([0-9,]*)\]", sig):
+            if m.group(1) not in bpe:
+                continue
+            n = 1
+            for d in m.group(2).split(","):
+                if d:
+                    n *= int(d)
+            out.append((n * bpe[m.group(1)], not m.group(2)))
+        return out
+
+    stats = {op: {"count": 0, "bytes": 0} for op in COLLECTIVE_OPS}
+    for line in hlo_text.splitlines():
+        line = line.strip()
+        m = re.match(r"%?\S+ = (\([^)]*\)|\S+) ([\w-]+)\(", line)
+        if not m:
+            continue
+        op = m.group(2)
+        base = op[:-len("-start")] if op.endswith("-start") else op
+        if base not in stats or op.endswith("-done"):
+            continue
+        shapes = shape_bytes_list(m.group(1))
+        if op.endswith("-start") and m.group(1).startswith("("):
+            # (operands…, results…[, context scalars]): the result half is
+            # the moved (output-shape) traffic — exact for unequal-size
+            # collectives like all-gather-start too, where halving the
+            # whole tuple under-reports by the axis-size factor.  u32[]
+            # context scalars are bookkeeping, not traffic.
+            arrays = [b for b, scalar in shapes if not scalar]
+            if arrays and len(arrays) % 2 == 0:
+                nbytes = sum(arrays[len(arrays) // 2:])
+            else:  # odd layout — halving is the best approximation left
+                nbytes = sum(arrays) // 2
+        else:
+            nbytes = sum(b for b, _ in shapes)
+        stats[base]["count"] += 1
+        stats[base]["bytes"] += nbytes
+    return {op: s for op, s in stats.items() if s["count"]}

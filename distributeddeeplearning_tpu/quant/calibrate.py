@@ -99,6 +99,18 @@ def quantize_params(
     return out
 
 
+def abstract_quantized_params(params_abs: PyTree) -> PyTree:
+    """ShapeDtypeStruct skeleton of :func:`quantize_params`' output with
+    no quantization math run — ``jax.eval_shape`` over the PTQ transform.
+
+    The static-analysis program audit (``analysis/program_audit.py``)
+    traces the int8-weight serving programs on exactly this skeleton, so
+    the audited QTensor layout (values int8, keepdims f32 scales at the
+    negative-axis convention) can never drift from what ``quantize_params``
+    actually produces."""
+    return jax.eval_shape(quantize_params, params_abs)
+
+
 def params_dtype(params: PyTree) -> str:
     """``"int8"`` when any matmul leaf is a QTensor, else the param dtype
     name — the ``weights_dtype`` provenance field of ServeReport."""

@@ -783,8 +783,8 @@ class FleetRouter:
         # Host bookkeeping only: queue pumps, health checks, least-loaded
         # dispatch.  The one blocking call is the outbox get with a short
         # timeout (the router's idle wait, not a device sync) — the
-        # hot-loop lint greps this region like the trainer/scheduler
-        # loops.
+        # AST host-sync checker scans this region (sync budget 0) like
+        # the trainer/scheduler loops; see analysis/regions.py.
         while len(results) < len(flights):
             live = [m for m in self._members if not m.dead]
             if self._drain_event.is_set() and backlog:

@@ -744,6 +744,10 @@ class ContinuousBatchingScheduler:
                 on_timeout=self.watchdog_on_timeout,
             ).start()
 
+        # The decode loop below is a registered hot region (sync budget
+        # 0 — the one designed sync lives inside engine.decode's token
+        # readback): analysis/host_sync.py fails `ddlt lint` and tier-1
+        # on any new per-step host coercion in its body.
         capped = False
         draining = False
         # live mode: with a poll source the loop stays alive while idle

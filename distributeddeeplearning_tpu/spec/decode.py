@@ -255,7 +255,9 @@ class SpeculativeDecoder:
             engine._cache = cache
         # THE one designed sync of the spec step (the scheduler needs the
         # committed ids to stream/complete) — everything above is
-        # dispatch-only, same budget as engine.decode's token readback
+        # dispatch-only, same budget as engine.decode's token readback.
+        # The three marked lines below ARE the spec region's sync_budget
+        # in analysis/regions.py: adding a sync here fails `ddlt lint`.
         out = np.asarray(greedy)  # sync-ok: the designed token readback
         acc = np.asarray(accepted)  # sync-ok: rides the same readback
         fin = np.asarray(finite)  # sync-ok: rides the same readback

@@ -1,0 +1,13 @@
+"""Fixture injection site: only ``covered_kind``'s hook is ever called.
+
+``fire_orphan`` appears below in a comment and a string — neither is a
+call, so the AST pass must still report ``orphan_kind`` as uncovered.
+"""
+
+# plan.fire_orphan() — a comment is not an injection site
+DOC = "plan.fire_orphan() in a string is not an injection site either"
+
+
+def run(plan):
+    if plan:
+        plan.fire_covered()

@@ -1,0 +1,11 @@
+"""Fixture faults module: two declared kinds, one injection hook each."""
+
+KINDS = ("covered_kind", "orphan_kind")
+
+
+class FaultPlan:
+    def fire_covered(self):
+        return True
+
+    def fire_orphan(self):
+        return True

@@ -1,0 +1,30 @@
+"""Seeded host-sync violations for the analyzer's detection pins.
+
+This module is NEVER imported by production code — the test points the
+AST checker at this file and asserts it catches exactly the planted
+syncs (and none of the regex era's false positives).
+"""
+
+import numpy as renamed_np  # alias rename: the regex grep missed this
+from numpy import asarray as local_asarray
+from jax import device_get as renamed_get  # noqa: F401  (fixture import)
+import jax.numpy as jnp  # noqa: F401
+
+
+def hot_loop(xs, engine):
+    """A decode-shaped hot loop with one sync per banned class."""
+    total = 0.0
+    note = "a float( inside a string must never be flagged"
+    for x in xs:  # the hot loop the fixture region locates
+        # a commented float( must never be flagged either
+        out = engine.decode(x)  # landmark
+        total += float(out)  # PLANTED: host coercion
+        arr = renamed_np.asarray(out)  # PLANTED: aliased np.asarray
+        arr2 = local_asarray(out)  # PLANTED: from-import alias
+        host = renamed_get(out)  # PLANTED: renamed jax.device_get
+        scalar = out.item()  # PLANTED: .item() readback
+        mapped = list(map(renamed_np.asarray, x))  # PLANTED: reference
+        keyed = sorted(x, key=renamed_get)  # PLANTED: ref via keyword
+        dev = jnp.asarray(x)  # clean: host->device upload, dispatch-only
+        del arr, arr2, host, scalar, mapped, keyed, dev, note
+    return total

@@ -1,0 +1,167 @@
+"""Seeded program-level violations: one bad jitted program per checker.
+
+Each builder returns a :class:`~distributeddeeplearning_tpu.analysis.
+program_audit.ProgramRecord` (or the raw pieces a checker consumes) whose
+planted bug exactly one program audit must catch.  Built lazily so
+importing the fixture module costs nothing until a test asks.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.analysis.program_audit import (
+    ProgramRecord,
+    _sds,
+)
+
+_CACHE = {
+    "k": jax.ShapeDtypeStruct((2, 2, 64, 2, 8), jnp.int8),
+    "v": jax.ShapeDtypeStruct((2, 2, 64, 2, 8), jnp.int8),
+    "k_scale": jax.ShapeDtypeStruct((2, 2, 64, 2), jnp.float32),
+    "v_scale": jax.ShapeDtypeStruct((2, 2, 64, 2), jnp.float32),
+}
+
+
+def lost_donation() -> ProgramRecord:
+    """A decode-shaped step that FORGOT donate_argnums on its cache."""
+
+    def step(cache, tok):
+        return {"k": cache["k"].at[0, 0].set(tok)}, tok + 1
+
+    jitted = jax.jit(step)  # planted: no donate_argnums=(0,)
+    return ProgramRecord(
+        "fixture.lost_donation", jitted,
+        ({"k": _sds((2, 4), jnp.int8)}, _sds((), jnp.int8)),
+        donate_min=1,
+    )
+
+
+def callback_in_jit() -> ProgramRecord:
+    """A hot program with a debug print (host round-trip) inside."""
+
+    def step(x):
+        jax.debug.print("x = {x}", x=x)  # planted: callback in jit
+        return x * 2.0
+
+    return ProgramRecord(
+        "fixture.callback_in_jit", jax.jit(step),
+        (_sds((4,), jnp.float32),),
+    )
+
+
+def hoisted_collective():
+    """A comm-overlap-shaped step whose gradient sync was hoisted OUT of
+    the accumulation scan into a post-scan all-reduce (the exact schedule
+    regression the in-scan reduce-scatter contract exists to catch).
+
+    Returns ``(jaxpr, n_buckets)`` for ``check_collective_contract``.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    import numpy as np
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs.reshape(len(devs)), ("data",))
+
+    def inner(micro):
+        def body(acc, xs):
+            grads = xs * 2.0  # stand-in backward
+            return acc + grads, ()  # planted: accumulates FULL grads
+
+        acc, _ = jax.lax.scan(body, jnp.zeros(micro.shape[-1]), micro)
+        # planted: ONE hoisted all-reduce after the scan instead of a
+        # per-microbatch in-scan reduce-scatter
+        g = jax.lax.psum(acc, "data")
+        metrics = jax.lax.pmean(acc.sum(), "data")
+        return g, metrics
+
+    sm = shard_map(
+        inner, mesh=mesh, in_specs=(P(None, "data"),),
+        out_specs=(P("data"), P()), check_rep=False,
+    )
+    traced = jax.jit(sm).trace(_sds((2, 8 * len(devs)), jnp.float32))
+    return traced.jaxpr.jaxpr
+
+
+def f32_history_returned() -> ProgramRecord:
+    """An int8-cache decode that dequantizes the WHOLE history and
+    returns it f32 — the QUANT_r10 materialization regression."""
+
+    def step(cache, tok):
+        hist = cache["k"].astype(jnp.float32) * cache["k_scale"][..., None]
+        out = dict(cache)
+        out["k"] = cache["k"].at[0, 0, 0, 0, 0].set(tok)
+        return out, hist  # planted: history-shaped f32 output
+
+    return ProgramRecord(
+        "fixture.f32_history_returned", jax.jit(step, donate_argnums=(0,)),
+        (_CACHE, _sds((), jnp.int8)),
+        donate_min=2, int8_history_len=64,
+    )
+
+
+def bf16_history_returned() -> ProgramRecord:
+    """Half-width evasion attempt: dequantize the history to bf16 and
+    return it — same materialization regression at half the bytes, and
+    the audit must not be fooled by the narrower float."""
+
+    def step(cache, tok):
+        hist = (
+            cache["k"].astype(jnp.bfloat16)
+            * cache["k_scale"][..., None].astype(jnp.bfloat16)
+        )
+        out = dict(cache)
+        out["k"] = cache["k"].at[0, 0, 0, 0, 0].set(tok)
+        return out, hist  # planted: history-shaped bf16 output
+
+    return ProgramRecord(
+        "fixture.bf16_history_returned", jax.jit(step, donate_argnums=(0,)),
+        (_CACHE, _sds((), jnp.int8)),
+        donate_min=2, int8_history_len=64,
+    )
+
+
+def f32_history_written() -> ProgramRecord:
+    """An int8-cache decode that writes dequantized f32 history back
+    into a persistent f32 buffer (storing what should stay fused)."""
+
+    def step(cache, f32_shadow, tok):
+        hist = cache["k"].astype(jnp.float32) * cache["k_scale"][..., None]
+        # planted: full-history f32 update stored via dynamic_update_slice
+        shadow = jax.lax.dynamic_update_slice(
+            f32_shadow, hist, (0, 0, 0, 0, 0)
+        )
+        out = dict(cache)
+        out["k"] = cache["k"].at[0, 0, 0, 0, 0].set(tok)
+        return out, shadow
+
+    return ProgramRecord(
+        "fixture.f32_history_written", jax.jit(step, donate_argnums=(0,)),
+        (_CACHE, _sds((2, 2, 64, 2, 8), jnp.float32), _sds((), jnp.int8)),
+        donate_min=2, int8_history_len=64,
+    )
+
+
+def unsharded_leaf():
+    """A cache tree that grew a leaf the sharding resolver doesn't know
+    — returns ``(tree_abs, shardings)`` for ``check_tree_coverage``."""
+    from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh
+    from distributeddeeplearning_tpu.serve.kv_cache import (
+        cache_sharding,
+        init_cache,
+    )
+
+    mesh = create_mesh(MeshSpec())
+    cache_abs = jax.eval_shape(
+        lambda: init_cache(
+            batch_slots=2, num_layers=2, max_seq=16, num_heads=2,
+            head_dim=8, dtype=jnp.int8,
+        )
+    )
+    # planted: a new leaf (asymmetric-quantization zero points) the
+    # resolver was never taught about
+    cache_abs = dict(cache_abs)
+    cache_abs["k_zero_point"] = jax.ShapeDtypeStruct(
+        (2, 2, 16, 2), jnp.float32
+    )
+    return cache_abs, cache_sharding(mesh, quantized=True)

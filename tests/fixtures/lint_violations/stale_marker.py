@@ -1,0 +1,16 @@
+"""Seeded stale ``# sync-ok`` marker for the dead-waiver detection pin."""
+
+
+def hot_loop(xs, detector):
+    total = 0.0
+    for x in xs:  # the hot loop the fixture region locates
+        # sync-ok markers (no colon) in prose must NOT count as waivers
+        out = step(x)  # landmark
+        loss = float(out)  # sync-ok: the designed anomaly-detector read
+        detector.observe(loss)
+        total = total + 1  # sync-ok: PLANTED dead waiver — nothing syncs
+    return total
+
+
+def step(x):
+    return x

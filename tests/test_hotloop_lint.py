@@ -1,290 +1,116 @@
 """Hot-loop host-sync lint — a tier-1 guard on dispatch pipelining.
 
 The trainer's throughput story depends on the step loop never blocking on
-device values: metrics accumulate on device and the host syncs only at the
-log interval (``train/loop.py``).  That property has been silently lost
-before (the r01 per-step ``float()`` cost ~2x) and nothing structural
-prevented it from regressing — so this lint greps the actual step-loop
-source for per-step host syncs (``float(``, ``.item()``, ``np.asarray``,
-``device_get``) and fails on any line not explicitly allow-listed with a
-``# sync-ok`` marker (today: the anomaly detector's documented
-one-sync-per-step price).  The jitted step builders are held to a stricter
-bar: no such token at all (inside jit they would either crash or silently
-fall back to host math).
+device values (the r01 per-step ``float()`` cost ~2x), and the same
+contract covers the serve decode loop, the fleet dispatch loop, the spec
+draft->verify loop, the jitted step builders and the obs hot API.
 
-The serve scheduler's decode loop gets the same treatment: its one
-designed sync is the sampled-token readback inside ``engine.decode``
-(host-side continuous batching needs the ids), so any OTHER per-step sync
-token in ``ContinuousBatchingScheduler.run``'s loop body fails the lint
-unless allow-listed.
+Since PR 9 the lint is a real analyzer: the declarative hot-region
+registry lives in ``analysis/regions.py`` and the AST checker in
+``analysis/host_sync.py`` — import-alias-resolved banned calls (``float(``
+/ ``.item()`` / ``np.asarray`` / ``device_get``), strings/comments
+structurally invisible, ``# sync-ok`` waivers budgeted exactly and
+stale markers flagged.  This file is the thin tier-1 wrapper: every
+registered region must be clean against the live source (regions come
+from the registry, not indentation scraping), plus the behavioral
+pin that enabling the tracer changes no compiled program.
 """
 
-import inspect
-import re
+import pytest
 
-# (?<![\w.]) on np.asarray keeps jnp.asarray — a host->device upload,
-# dispatch-only — from false-positives; bare np.asarray IS a readback
-BANNED = re.compile(
-    r"(?<![\w.])float\(|\.item\(\)|(?<![\w.])np\.asarray|device_get"
+from distributeddeeplearning_tpu.analysis import format_findings, host_sync
+from distributeddeeplearning_tpu.analysis.regions import (
+    ALL_REGIONS,
+    JIT_BUILDER_REGIONS,
+    OBS_HOT_REGIONS,
+    get_region,
 )
-MARKER = "sync-ok"
 
 
-def _step_loop_body():
-    """Source lines of the ``for step_i in range(...)`` hot loop inside
-    ``Trainer._fit_inner`` (by indentation, comments included)."""
-    from distributeddeeplearning_tpu.train.loop import Trainer
-
-    lines = inspect.getsource(Trainer._fit_inner).splitlines()
-    start = next(
-        i for i, line in enumerate(lines) if "for step_i in range" in line
+def _assert_clean(region_name: str) -> None:
+    region = get_region(region_name)
+    findings = host_sync.check_region(region)
+    assert not findings, (
+        f"hot region {region_name} has open findings:\n"
+        + format_findings(findings)
     )
-    indent = len(lines[start]) - len(lines[start].lstrip())
-    body = []
-    for line in lines[start + 1:]:
-        if line.strip() and (len(line) - len(line.lstrip())) <= indent:
-            break
-        body.append(line)
-    assert body, "could not locate the step loop body"
-    return body
 
 
 def test_trainer_step_loop_has_no_unmarked_host_sync():
-    offenders = [
-        line.strip()
-        for line in _step_loop_body()
-        if BANNED.search(line) and MARKER not in line
-    ]
-    assert not offenders, (
-        "per-step host sync in Trainer.fit's hot loop — this serializes "
-        "dispatch on every step.  Move it to the log-interval block, or if "
-        "it is a deliberate documented price (like the anomaly detector's "
-        f"per-step read) tag the line with '# {MARKER}':\n  "
-        + "\n  ".join(offenders)
-    )
-
-
-def test_trainer_step_loop_allowlist_is_alive():
-    """The lint must be exercising something: the anomaly detector's
-    documented sync lines carry the marker (if they move out of the loop,
-    update the lint's docstring story too)."""
-    body = _step_loop_body()
-    marked = [line for line in body if MARKER in line and BANNED.search(line)]
-    assert marked, "no allow-listed sync lines found — lint may be scanning the wrong region"
-
-
-def _serve_loop_body():
-    """Source lines of the scheduler's ``while pending or active ...``
-    decode loop inside ``ContinuousBatchingScheduler.run`` (by
-    indentation, comments included) — the serving hot loop: one decode
-    step per iteration, admission between steps."""
-    from distributeddeeplearning_tpu.serve.scheduler import (
-        ContinuousBatchingScheduler,
-    )
-
-    lines = inspect.getsource(ContinuousBatchingScheduler.run).splitlines()
-    start = next(
-        i for i, line in enumerate(lines)
-        if "while pending or active" in line
-    )
-    indent = len(lines[start]) - len(lines[start].lstrip())
-    body = []
-    for line in lines[start + 1:]:
-        if line.strip() and (len(line) - len(line.lstrip())) <= indent:
-            break
-        body.append(line)
-    assert body, "could not locate the serve decode loop body"
-    return body
+    """Per-step host syncs in Trainer._fit_inner's step loop serialize
+    dispatch; the anomaly detector's documented reads are the only
+    waived lines (budget-checked below by the same analyzer)."""
+    _assert_clean("trainer-step-loop")
 
 
 def test_serve_decode_loop_has_no_unmarked_host_sync():
-    """Same lint as the trainer loop, for the serving hot path: the
-    scheduler's ONE designed host sync is the token readback inside
-    ``engine.decode`` (the host-side scheduler needs the sampled ids to
-    admit/release slots) — anything else (``float(``/``.item()``/
-    ``np.asarray``/``device_get``) in the loop body is a new per-step
-    stall and must carry a ``# sync-ok`` marker with its justification."""
-    body = _serve_loop_body()
-    # right-region guard: the loop we grep must be the one that decodes
-    assert any("engine.decode" in line for line in body), (
-        "serve lint is not scanning the decode loop"
-    )
-    offenders = [
-        line.strip()
-        for line in body
-        if BANNED.search(line) and MARKER not in line
-    ]
-    assert not offenders, (
-        "per-step host sync in the serve scheduler's decode loop — this "
-        "serializes dispatch against every decode step.  Move it to the "
-        "end-of-run report block, or tag a deliberate documented price "
-        f"with '# {MARKER}':\n  " + "\n  ".join(offenders)
-    )
-
-
-def _fleet_dispatch_loop_body():
-    """Source lines of the fleet router's dispatch loop inside
-    ``FleetRouter.serve`` (by indentation, comments included) — the
-    cross-process serving hot loop: queue pumps, health checks and
-    least-loaded dispatch between the workers' decode steps."""
-    from distributeddeeplearning_tpu.serve.fleet import FleetRouter
-
-    lines = inspect.getsource(FleetRouter.serve).splitlines()
-    start = next(
-        i for i, line in enumerate(lines)
-        if "while len(results) < len(flights)" in line
-    )
-    indent = len(lines[start]) - len(lines[start].lstrip())
-    body = []
-    for line in lines[start + 1:]:
-        if line.strip() and (len(line) - len(line.lstrip())) <= indent:
-            break
-        body.append(line)
-    assert body, "could not locate the fleet dispatch loop body"
-    return body
+    """The scheduler's ONE designed sync is the token readback inside
+    ``engine.decode``; the loop body itself budgets zero."""
+    _assert_clean("serve-decode-loop")
 
 
 def test_fleet_dispatch_loop_has_no_unmarked_host_sync():
-    """The router is host bookkeeping by design — its ONE blocking call
-    is the outbox get with a short timeout (the idle wait on worker
-    messages, not a device sync).  Any device-value token (``float(``/
-    ``.item()``/``np.asarray``/``device_get``) appearing in the dispatch
-    loop means engine state leaked across the process boundary into the
-    router's per-iteration path; that must carry a ``# sync-ok`` marker
-    with its justification or move into the workers."""
-    body = _fleet_dispatch_loop_body()
-    # right-region guard: the loop we grep must be the one that pumps the
-    # outbox and supervises replica health
-    assert any("self._outbox.get" in line for line in body), (
-        "fleet lint is not scanning the dispatch loop"
-    )
-    assert any("handle_death" in line for line in body), (
-        "fleet lint is not scanning the supervision path"
-    )
-    offenders = [
-        line.strip()
-        for line in body
-        if BANNED.search(line) and MARKER not in line
-    ]
-    assert not offenders, (
-        "host-sync token in the fleet router's dispatch loop — the "
-        "router must stay pure host bookkeeping (device values never "
-        "cross the process boundary).  Move the work into the replica "
-        "workers, or tag a deliberate documented price with "
-        f"'# {MARKER}':\n  " + "\n  ".join(offenders)
-    )
-
-
-def _spec_step_body():
-    """Source lines of ``SpeculativeDecoder.step`` — the draft->verify
-    hot loop speculative serving runs once per scheduler iteration: K
-    device-chained draft dispatches, one batched verify dispatch, and
-    exactly ONE designed readback (the committed tokens + acceptance +
-    finiteness riding a single sync)."""
-    from distributeddeeplearning_tpu.spec.decode import SpeculativeDecoder
-
-    return inspect.getsource(SpeculativeDecoder.step).splitlines()
+    """The router is host bookkeeping by design — any device-value token
+    in its dispatch loop means engine state leaked across the process
+    boundary."""
+    _assert_clean("fleet-dispatch-loop")
 
 
 def test_spec_draft_verify_loop_has_no_unmarked_host_sync():
-    """The spec step's budget is the same as ``engine.decode``'s: one
-    readback per step, everything else dispatch-only.  A host sync
-    between draft dispatches would serialize the whole chain (K round
-    trips instead of one), so any banned token here must carry a
-    ``# sync-ok`` marker with its justification."""
-    body = _spec_step_body()
-    # right-region guards: the source we grep must contain BOTH halves
-    # of the loop — the draft dispatch chain and the verify dispatch
-    assert any("drafter.propose" in line for line in body), (
-        "spec lint is not scanning the draft dispatch chain"
-    )
-    assert any("self._verify_jit" in line for line in body), (
-        "spec lint is not scanning the verify dispatch"
-    )
-    offenders = [
-        line.strip()
-        for line in body
-        if BANNED.search(line) and MARKER not in line
-    ]
-    assert not offenders, (
-        "host-sync token in the spec draft->verify loop — a sync between "
-        "draft dispatches serializes the chain into K round trips.  "
-        "Batch it into the verify readback, or tag a deliberate "
-        f"documented price with '# {MARKER}':\n  " + "\n  ".join(offenders)
-    )
+    """A host sync between draft dispatches serializes the chain into K
+    round trips; the one designed readback (tokens + acceptance +
+    finiteness on one sync) is the whole budget."""
+    _assert_clean("spec-draft-verify-loop")
+
+
+@pytest.mark.parametrize(
+    "region", JIT_BUILDER_REGIONS, ids=lambda r: r.name
+)
+def test_step_builders_have_no_host_sync_tokens(region):
+    """Inside jit a host coercion is a bug, full stop — markers are not
+    honored in the builder regions."""
+    _assert_clean(region.name)
+
+
+@pytest.mark.parametrize(
+    "region", OBS_HOT_REGIONS, ids=lambda r: r.name
+)
+def test_tracer_hot_api_has_no_sync_tokens(region):
+    """Everything on the span/event/record hot path is pure host
+    bookkeeping; its two documented host-scalar coercions are the only
+    budgeted waivers."""
+    _assert_clean(region.name)
+
+
+def test_trainer_step_loop_allowlist_is_alive():
+    """The lint must be exercising something: the registry still demands
+    the anomaly detector's three designed syncs (the analyzer fails the
+    region if the live marker count drifts from this budget in either
+    direction)."""
+    assert get_region("trainer-step-loop").sync_budget == 3
 
 
 def test_spec_step_allowlist_is_alive():
-    """The designed readback (committed tokens/acceptance/finiteness)
-    carries the marker — if it moves, the lint must follow it."""
-    body = _spec_step_body()
-    marked = [
-        line for line in body if MARKER in line and BANNED.search(line)
-    ]
-    assert marked, (
-        "no allow-listed sync lines found in SpeculativeDecoder.step — "
-        "lint may be scanning the wrong region"
-    )
-
-
-def test_step_builders_have_no_host_sync_tokens():
-    from distributeddeeplearning_tpu.train import step as step_mod
-
-    for fn in (step_mod.build_train_step, step_mod._build_comm_overlap_step,
-               step_mod.build_eval_step):
-        for line in inspect.getsource(fn).splitlines():
-            code = line.split("#", 1)[0]
-            assert not BANNED.search(code), (
-                f"host-sync token inside jitted step builder "
-                f"{fn.__name__}: {line.strip()!r}"
-            )
-
-
-# --- obs instrumentation (PR 6) ------------------------------------------
-# The tracer lives INSIDE both hot loops now, so it gets the same
-# treatment: its hot API must be sync-free, the instrumented regions must
-# actually be instrumented (a silent revert would pass the greps above),
-# and flipping the tracer on must not change what XLA compiled.
-
-
-def test_tracer_hot_api_has_no_sync_tokens():
-    """Everything on the span/event/record hot path is pure host
-    bookkeeping — no device reads, ever (zero-sync by construction)."""
-    from distributeddeeplearning_tpu.obs import registry as reg_mod
-    from distributeddeeplearning_tpu.obs import trace as trace_mod
-
-    hot = (
-        trace_mod.Tracer.span,
-        trace_mod.Tracer.event,
-        trace_mod._Span.__enter__,
-        trace_mod._Span.__exit__,
-        trace_mod._NullSpan.__enter__,
-        trace_mod._NullSpan.__exit__,
-        reg_mod.Histogram.record,
-        reg_mod.Counter.inc,
-        reg_mod.Gauge.set,
-    )
-    for fn in hot:
-        for line in inspect.getsource(fn).splitlines():
-            if MARKER in line:  # documented host-scalar coercions
-                continue
-            code = line.split("#", 1)[0]
-            assert not BANNED.search(code), (
-                f"host-sync token in obs hot API {fn.__qualname__}: "
-                f"{line.strip()!r}"
-            )
+    """The spec step's designed readback spans three marked lines — a
+    budget of zero would mean the lint stopped guarding the real loop."""
+    assert get_region("spec-draft-verify-loop").sync_budget == 3
 
 
 def test_hot_loops_are_instrumented():
-    """The tracer calls inside the two hot loops are load-bearing (the
-    OBS timeline is built from them); the sync-lint above would not
-    notice them silently disappearing."""
-    assert any(
-        "trace.span(" in line for line in _step_loop_body()
-    ), "Trainer step loop lost its obs spans"
-    assert any(
-        "trace.span(" in line for line in _serve_loop_body()
-    ), "serve decode loop lost its obs spans"
+    """The obs spans inside the trainer/serve hot loops are load-bearing
+    (the OBS timeline is built from them); the registry pins them as
+    landmarks so the analyzer fails if they silently disappear."""
+    assert "trace.span(" in get_region("trainer-step-loop").landmarks
+    assert "trace.span(" in get_region("serve-decode-loop").landmarks
+
+
+def test_every_registered_region_is_clean():
+    """The whole registry in one sweep — new regions added to
+    analysis/regions.py are automatically under tier-1."""
+    findings = []
+    for region in ALL_REGIONS:
+        findings.extend(host_sync.check_region(region))
+    assert not findings, format_findings(findings)
 
 
 def test_disabled_then_enabled_tracer_adds_no_jit_recompiles():
