@@ -42,7 +42,7 @@ V100_TF_CNN_BENCHMARKS_IMG_SEC = 720.0
 #: ``_rNN`` suffix (the drift that left COMMS at r09 while RESILIENCE sat
 #: at r07).  Committed artifacts keep their historical names; NEW runs
 #: write ``<KIND>_r{BENCH_REVISION}.json``.
-BENCH_REVISION = 13
+BENCH_REVISION = 14
 
 
 def artifact_name(kind: str) -> str:
@@ -1662,6 +1662,181 @@ def _run_obs(args) -> int:
     return 0
 
 
+def _run_obs_fleet(args) -> int:
+    """Fleet-observability benchmark: a chaos fleet whose recovery is
+    VISIBLE, not just survived.
+
+    Runs a 2-replica (``--serve-replicas``) paged-engine fleet through
+    ``--obs-fleet-spec`` (a replica death + a decode stall by default)
+    with distributed request tracing on: the router mints one trace id
+    per request, workers tag every scheduler span with (trace,
+    replica) and export per-process Chrome-trace shards, and
+    ``obs.fleet`` merges the shards onto the router clock into
+    ``fleet.trace.json``.  Emits ``OBS_FLEET_r{NN}.json`` gated on:
+
+    - **failover_traceable**: at least one requeued request's chain in
+      the MERGED timeline shows the full story — served on the dying
+      replica → ``fleet/replica_died`` → ``fleet/request_requeued`` →
+      completion on a different process — under one trace id;
+    - **percentiles_merge_exact**: the artifact's fleet TTFT/TPOT
+      percentile blocks equal a from-scratch recomputation off the
+      committed per-replica histogram buckets, in any merge order
+      (bucket merging is exact; averaging percentiles would not be);
+    - **zero_lost_requests**: the chaos run loses nothing;
+    - **slo_pass**: the declarative ``--slo`` spec holds over the
+      merged fleet metrics.
+
+    The artifact is validated against the registered ``OBS_FLEET_*``
+    schema before it is written.
+    """
+    import os
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from distributeddeeplearning_tpu.obs.fleet import (
+        SLOSpec,
+        fleet_latency,
+        observe_fleet,
+    )
+    from distributeddeeplearning_tpu.obs.registry import merge_states
+    from distributeddeeplearning_tpu.obs.schema import (
+        validate_obs_fleet_payload,
+    )
+    from distributeddeeplearning_tpu.serve import (
+        ReplicaSpec,
+        synthetic_requests,
+    )
+    from distributeddeeplearning_tpu.utils import faults as faults_mod
+
+    if not any(
+        s.kind == "replica_death"
+        for s in faults_mod.parse_spec(args.obs_fleet_spec)
+    ):
+        print(
+            "[obs-fleet] --obs-fleet-spec must inject a replica_death — "
+            "the artifact's whole point is a traceable failover",
+            file=sys.stderr,
+        )
+        return 1
+    slo = SLOSpec.parse(args.slo)
+    dims = dict(num_layers=4, d_model=256, num_heads=8, d_ff=1024,
+                vocab_size=8193)
+    if args.small:
+        dims = dict(num_layers=2, d_model=64, num_heads=4, d_ff=128,
+                    vocab_size=257)
+    max_prompt = max(8, args.seq_len if not args.small else 12)
+    new_tokens = args.obs_fleet_new_tokens
+    max_seq = max_prompt + new_tokens
+    spec = ReplicaSpec(
+        model=dict(max_len=max_seq, **dims),
+        seed=0,
+        num_heads=dims["num_heads"],
+        batch_slots=args.batch_slots,
+        max_seq=max_seq,
+        kv_layout="paged",
+        page_size=args.page_size,
+        num_pages=args.kv_pages,
+        prefill_chunk=args.prefill_chunk,
+        temperature=0.0,
+        max_new_tokens=new_tokens,
+    )
+    requests = synthetic_requests(
+        args.obs_fleet_requests, vocab_size=dims["vocab_size"],
+        max_prompt=max_prompt, min_prompt=max(2, max_prompt // 8),
+        rng=np.random.default_rng(0),
+    )
+    trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="ddlt-obs-fleet-")
+    print(
+        f"[obs-fleet] chaos fleet: {args.serve_replicas} replicas, "
+        f"{len(requests)} requests, faults={args.obs_fleet_spec}",
+        file=sys.stderr,
+    )
+    view = observe_fleet(
+        spec, requests,
+        replicas=args.serve_replicas,
+        trace_dir=trace_dir,
+        faults=args.obs_fleet_spec,
+        slo=slo,
+        max_restarts=args.serve_max_restarts,
+    )
+    report = view["fleet_report"]
+
+    # gate (a): the failover is traceable end-to-end under one trace id
+    chains_ok = sum(1 for c in view["failover"].values() if c["ok"])
+    failover_traceable = report.replica_deaths >= 1 and chains_ok >= 1
+
+    # gate (b): the fleet percentiles must be EXACTLY reproducible from
+    # the committed per-replica buckets — recomputed here in reversed
+    # merge order, so order-dependence would fail too
+    recomputed = fleet_latency(
+        merge_states(list(reversed(view["per_replica_metrics"])))
+    )
+    merge_exact = recomputed == view["fleet_latency"]
+
+    gates = {
+        "failover_traceable": bool(failover_traceable),
+        "percentiles_merge_exact": bool(merge_exact),
+        "zero_lost_requests": report.lost_requests == 0,
+        "slo_pass": bool(view["slo"]["pass"]),
+    }
+    line = {
+        "metric": "serve_fleet_obs_ttft_p99_s",
+        # the headline is the number the SLO layer gates: fleet-level
+        # TTFT p99 from bucket-merged worker histograms, measured UNDER
+        # chaos (the failover cost is inside it, not hidden per-replica)
+        "value": view["fleet_latency"]["ttft_s"]["p99"],
+        "unit": "s",
+        "vs_baseline": None,
+        "bench_revision": BENCH_REVISION,
+        "faults_spec": args.obs_fleet_spec,
+        "replicas": args.serve_replicas,
+        "requests": len(requests),
+        "max_new_tokens": new_tokens,
+        "model_dims": dims,
+        "merged_trace_path": view["merged_trace_path"],
+        "timeline": view["timeline"],
+        "failover": view["failover"],
+        "failover_chains_ok": chains_ok,
+        "fleet_latency": view["fleet_latency"],
+        "fleet_latency_recomputed": recomputed,
+        "fleet_metrics": view["fleet_metrics"],
+        "per_replica_metrics": view["per_replica_metrics"],
+        "flight_recorder_dumps": len(view["flight_recorder_dumps"]),
+        "flight_recorder_dump_reasons": sorted(
+            {d.get("reason") for d in view["flight_recorder_dumps"]}
+        ),
+        "slo": view["slo"],
+        "gates": gates,
+        "fleet_report": report.to_dict(),
+        "platform": jax.default_backend(),
+        "virtual_pod": _is_virtual_pod(),
+    }
+    # self-check before emitting: the artifact the README documents is
+    # the artifact tier-1 validates — drift fails HERE, not months later
+    validate_obs_fleet_payload(line)
+    print(json.dumps({
+        k: line[k] for k in (
+            "metric", "value", "unit", "vs_baseline", "faults_spec",
+            "failover_chains_ok", "gates",
+        )
+    }))
+    report_path = args.report or artifact_name("OBS_FLEET")
+    with open(report_path, "w") as f:
+        json.dump(line, f, indent=2)
+        f.write("\n")
+    print(f"[obs-fleet] report -> {report_path}", file=sys.stderr)
+    print(
+        f"[obs-fleet] merged fleet trace -> {view['merged_trace_path']}",
+        file=sys.stderr,
+    )
+    if not all(gates.values()):
+        print(f"[obs-fleet] GATES FAILED: {gates}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _run_faults(args) -> int:
     """Chaos benchmark: the REAL ``ddlt train --max-restarts`` supervisor
     driven over an injected fault schedule, measured against the identical
@@ -2502,6 +2677,48 @@ def main() -> int:
         "full merged Chrome trace lands in --trace-dir",
     )
     parser.add_argument(
+        "--obs-fleet",
+        action="store_true",
+        help="fleet-observability benchmark: a multi-replica chaos fleet "
+        "(replica_death + decode_stall) with distributed request tracing "
+        "— per-worker Chrome-trace shards merged onto the router clock, "
+        "bucket-merged fleet TTFT/TPOT percentiles, flight-recorder "
+        "dumps, SLO evaluation; emits OBS_FLEET_r{NN}.json and gates on "
+        "the failover being traceable under one trace id, exact "
+        "percentile merging, zero lost requests and the SLO verdict",
+    )
+    parser.add_argument(
+        "--obs-fleet-spec",
+        default="replica_death@3,decode_stall@6:secs=0.2",
+        help="DDLT_FAULTS schedule for --obs-fleet (must contain a "
+        "replica_death: the artifact's whole point is a traceable "
+        "failover)",
+    )
+    parser.add_argument(
+        "--obs-fleet-requests",
+        type=int,
+        default=24,
+        help="request count for --obs-fleet (enough that the death "
+        "orphans in-flight work and the restarted replica rejoins "
+        "mid-run)",
+    )
+    parser.add_argument(
+        "--obs-fleet-new-tokens",
+        type=int,
+        default=12,
+        help="per-request generation budget for --obs-fleet",
+    )
+    parser.add_argument(
+        "--slo",
+        default=(
+            "ttft_p99_s=60,tpot_p99_s=10,"
+            "max_error_rate=0,max_lost_requests=0"
+        ),
+        help="SLO spec for --obs-fleet, evaluated over the bucket-merged "
+        "fleet metrics (latency limits sized for CPU chaos runs; tighten "
+        "on hardware)",
+    )
+    parser.add_argument(
         "--comms",
         action="store_true",
         help="benchmark the explicit gradient-comms schedule "
@@ -2662,6 +2879,17 @@ def main() -> int:
             "--obs is exclusive with --serve/--devices/--data/"
             "--faults/--comms"
         )
+    if args.obs_fleet and (args.serve or args.devices or args.data
+                           or args.faults or args.comms or args.quant
+                           or args.obs or args.spec or args.serve_faults):
+        parser.error(
+            "--obs-fleet is exclusive with the other benchmark modes"
+        )
+    if args.obs_fleet and args.serve_replicas < 2:
+        parser.error(
+            "--obs-fleet needs --serve-replicas >= 2 (replica_death "
+            "must leave a survivor for the failover chain to land on)"
+        )
     if args.spec and (args.serve or args.devices or args.data
                       or args.faults or args.comms or args.quant
                       or args.obs or args.serve_faults):
@@ -2805,6 +3033,8 @@ def main() -> int:
         return _run_spec(args)
     if args.obs:
         return _run_obs(args)
+    if args.obs_fleet:
+        return _run_obs_fleet(args)
     if args.comms:
         return _run_comms(args)
     if args.devices:

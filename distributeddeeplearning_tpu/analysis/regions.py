@@ -84,6 +84,16 @@ HOT_REGIONS: Tuple[HotRegion, ...] = (
         # finiteness ride a single sync across three marked lines
         sync_budget=3,
     ),
+    HotRegion(
+        name="fleet-worker-metrics-ship",
+        module="distributeddeeplearning_tpu.serve.fleet",
+        qualname="_ship_metrics",
+        # the shipped state is host counters + histogram buckets by
+        # construction — a sync token here means engine state leaked
+        # into the metrics plane
+        landmarks=("outbox.put(", "get_registry().state()"),
+        sync_budget=0,
+    ),
 )
 
 #: Jitted step builders: no host-sync token at all — inside jit it would
@@ -114,6 +124,7 @@ JIT_BUILDER_REGIONS: Tuple[HotRegion, ...] = (
 #: coercions are marked and budgeted.
 _OBS_TRACE = "distributeddeeplearning_tpu.obs.trace"
 _OBS_REG = "distributeddeeplearning_tpu.obs.registry"
+_OBS_RECORDER = "distributeddeeplearning_tpu.obs.recorder"
 OBS_HOT_REGIONS: Tuple[HotRegion, ...] = (
     HotRegion(name="obs-tracer-span", module=_OBS_TRACE, qualname="Tracer.span"),
     HotRegion(name="obs-tracer-event", module=_OBS_TRACE, qualname="Tracer.event"),
@@ -137,6 +148,27 @@ OBS_HOT_REGIONS: Tuple[HotRegion, ...] = (
         module=_OBS_REG,
         qualname="Gauge.set",
         sync_budget=1,  # the documented host-scalar coercion
+    ),
+    # the flight-recorder record path: ON even with the tracer disabled,
+    # so it sits inside every hot loop unconditionally — zero designed
+    # syncs (entries are host timestamps/scalars by contract) and the
+    # ring append is the whole cost
+    HotRegion(
+        name="obs-recorder-record",
+        module=_OBS_RECORDER,
+        qualname="FlightRecorder.record",
+        landmarks=("self._ring.append",),
+    ),
+    HotRegion(
+        name="obs-recorder-span-enter",
+        module=_OBS_RECORDER,
+        qualname="_RecorderSpan.__enter__",
+    ),
+    HotRegion(
+        name="obs-recorder-span-exit",
+        module=_OBS_RECORDER,
+        qualname="_RecorderSpan.__exit__",
+        landmarks=("self._rec.record",),
     ),
 )
 

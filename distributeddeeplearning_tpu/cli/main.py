@@ -502,7 +502,32 @@ def build_parser() -> argparse.ArgumentParser:
     )
     obs_train.add_argument("--steps", type=int, default=8)
     obs_train.add_argument("--batch-size", type=int, default=16)
-    for p in (obs_serve, obs_train):
+    obs_fleet = obs_sub.add_parser(
+        "fleet",
+        help="fleet-scale observability smoke: a multi-replica chaos "
+        "run with distributed tracing (per-worker shards merged onto "
+        "the router clock -> fleet.trace.json), bucket-merged fleet "
+        "TTFT/TPOT percentiles, flight-recorder dumps, and the SLO "
+        "verdict",
+    )
+    obs_fleet.add_argument("--replicas", type=int, default=2)
+    obs_fleet.add_argument("--requests", type=int, default=12)
+    obs_fleet.add_argument("--batch-slots", type=int, default=2)
+    obs_fleet.add_argument("--max-new-tokens", type=int, default=8)
+    obs_fleet.add_argument("--prompt-len", type=int, default=10)
+    obs_fleet.add_argument(
+        "--faults", default="replica_death@3,decode_stall@5:secs=0.2",
+        help="serve-side DDLT_FAULTS schedule dealt across the fleet "
+        "(default injects one death + one stall so the merged timeline "
+        "shows a real failover)",
+    )
+    obs_fleet.add_argument(
+        "--slo", default="max_error_rate=0,max_lost_requests=0",
+        help="declarative SLO spec evaluated over the merged fleet "
+        "metrics, e.g. 'ttft_p99_s=2.0,tpot_p99_s=0.5,"
+        "max_error_rate=0,max_lost_requests=0'; exit 1 on violation",
+    )
+    for p in (obs_serve, obs_train, obs_fleet):
         p.add_argument(
             "--trace-dir", default="ddlt-obs",
             help="output dir: device trace + merged.trace.json + "
@@ -1713,6 +1738,9 @@ def _cmd_obs(args) -> int:
     import json as _json
     import os
 
+    if args.obs_command == "fleet":
+        return _cmd_obs_fleet(args)
+
     import jax
     import numpy as np
 
@@ -1839,6 +1867,90 @@ def _cmd_obs(args) -> int:
         f"[obs] open {merged_path} in chrome://tracing or "
         "https://ui.perfetto.dev", file=sys.stderr,
     )
+    return 0
+
+
+def _cmd_obs_fleet(args) -> int:
+    """``ddlt obs fleet`` — fleet-scale observability as a verb.
+
+    Runs a small multi-replica chaos fleet (synthetic traffic, tiny
+    dims) with distributed tracing on: the router mints a trace id per
+    request, every worker exports a Chrome-trace shard, and the merged
+    ``fleet.trace.json`` shows the injected failover end-to-end under
+    one trace id.  Fleet TTFT/TPOT come from bucket-merged worker
+    histograms; the ``--slo`` spec is evaluated over them (exit 1 on
+    violation) and any flight-recorder dumps ride the summary.
+
+    For the gated artifact (``OBS_FLEET_r{NN}.json``) use ``bench.py
+    --obs-fleet``; this verb is the quick "show me the fleet timeline"
+    loop.
+    """
+    import json as _json
+
+    import numpy as np
+
+    from distributeddeeplearning_tpu.obs.fleet import SLOSpec, observe_fleet
+    from distributeddeeplearning_tpu.serve import (
+        ReplicaSpec,
+        synthetic_requests,
+    )
+
+    try:
+        slo = SLOSpec.parse(args.slo)
+    except ValueError as exc:
+        print(f"bad --slo: {exc}", file=sys.stderr)
+        return 1
+    dims = dict(num_layers=2, d_model=64, num_heads=4, d_ff=128,
+                vocab_size=257)
+    max_seq = args.prompt_len + args.max_new_tokens
+    spec = ReplicaSpec(
+        model=dict(max_len=max_seq, **dims),
+        seed=0,
+        num_heads=dims["num_heads"],
+        batch_slots=args.batch_slots,
+        max_seq=max_seq,
+        kv_layout="paged",
+        page_size=8,
+        prefill_chunk=8,
+        temperature=0.0,
+        max_new_tokens=args.max_new_tokens,
+    )
+    requests = synthetic_requests(
+        args.requests, vocab_size=dims["vocab_size"],
+        max_prompt=args.prompt_len,
+        rng=np.random.default_rng(0),
+    )
+    view = observe_fleet(
+        spec, requests,
+        replicas=args.replicas,
+        trace_dir=args.trace_dir,
+        faults=args.faults,
+        slo=slo,
+    )
+    report = view["fleet_report"]
+    chains_ok = sum(1 for c in view["failover"].values() if c["ok"])
+    print(_json.dumps({
+        "mode": "fleet",
+        "merged_trace": view["merged_trace_path"],
+        "replicas": args.replicas,
+        "requests": report.requests,
+        "replica_deaths": report.replica_deaths,
+        "restarts": report.restarts,
+        "redeliveries": report.redeliveries,
+        "lost_requests": report.lost_requests,
+        "failover_chains": len(view["failover"]),
+        "failover_chains_ok": chains_ok,
+        "fleet_latency": view["fleet_latency"],
+        "flight_recorder_dumps": len(view["flight_recorder_dumps"]),
+        "slo": view["slo"],
+    }))
+    print(
+        f"[obs] open {view['merged_trace_path']} in chrome://tracing or "
+        "https://ui.perfetto.dev", file=sys.stderr,
+    )
+    if view["slo"] is not None and not view["slo"]["pass"]:
+        print("[obs] SLO VIOLATED", file=sys.stderr)
+        return 1
     return 0
 
 

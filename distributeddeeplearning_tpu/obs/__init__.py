@@ -13,19 +13,33 @@ The package every layer reports through (ISSUE 6 / OBS_r11):
 - :mod:`obs.profile` — merges the ``jax.profiler`` device trace with the
   host spans onto one clock, and measures per-phase decode breakdowns
   (the QUANT_r10 int8-regression attribution);
+- :mod:`obs.recorder` — the crash flight recorder: a bounded ring of
+  recent spans/events/metric deltas that stays ON with the tracer
+  disabled, dumped on watchdog fire / quarantine / replica death /
+  unhandled worker exception;
+- :mod:`obs.fleet` — fleet-scale merge: worker trace shards aligned
+  onto the router clock, bucket-merged cross-process metrics, and the
+  declarative :class:`~obs.fleet.SLOSpec` gate;
 - :mod:`obs.schema` — artifact validation, so committed ``*_r*.json``
   drift fails tier-1 instead of rotting.
 
-Entry points: ``ddlt obs {train,serve}``, ``ddlt serve --trace-dir`` and
-``bench.py --obs`` (the ``OBS_r{NN}.json`` artifact).
+Entry points: ``ddlt obs {train,serve,fleet}``, ``ddlt serve
+--trace-dir`` and ``bench.py --obs`` / ``--obs-fleet`` (the
+``OBS_r{NN}.json`` / ``OBS_FLEET_r{NN}.json`` artifacts).
 """
 
+from distributeddeeplearning_tpu.obs.recorder import (
+    FlightRecorder,
+    get_recorder,
+    set_recorder,
+)
 from distributeddeeplearning_tpu.obs.registry import (
     Counter,
     Gauge,
     Histogram,
     MetricsRegistry,
     get_registry,
+    merge_states,
     set_registry,
     summarize,
 )
@@ -38,13 +52,17 @@ from distributeddeeplearning_tpu.obs.trace import (
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "Tracer",
     "configure",
+    "get_recorder",
     "get_registry",
     "get_tracer",
+    "merge_states",
+    "set_recorder",
     "set_registry",
     "set_tracer",
     "summarize",

@@ -190,12 +190,16 @@ def merge_host_device(
         )
         merged["metadata"]["clock_offset_us"] = offset
         merged["metadata"]["clock_alignment"] = "coarse (no shared span name)"
+    host_pids = set(merged.get("metadata", {}).get("host_pids") or [1])
+    # keep host pids exclusive to tracer spans in the merge: a device
+    # event landing on a host pid would interleave two processes into
+    # one track (the same collision the fleet shard merge guards)
+    remap = max(host_pids) + 1
     shifted = []
     for ev in device_events:
         ev = dict(ev)
-        if ev.get("pid") == 1:
-            # keep the host pid exclusive to tracer spans in the merge
-            ev["pid"] = 2
+        if ev.get("pid") in host_pids:
+            ev["pid"] = remap
         if "ts" in ev:
             ev["ts"] = float(ev["ts"]) + offset
         shifted.append(ev)
@@ -211,8 +215,18 @@ def summarize_timeline(
     events in chronological order (the full trace goes to disk, the
     digest goes in the JSON artifact)."""
     events = merged.get("traceEvents", [])
-    host = [e for e in events if e.get("ph") == "X" and e.get("pid") == 1]
-    device = [e for e in events if e.get("ph") == "X" and e.get("pid") != 1]
+    # host lanes are whatever pids the tracer(s) stamped — recorded in
+    # the container metadata (fleet merges union every shard's pid);
+    # pid 1 is the pre-derived-pid fallback for old traces
+    host_pids = set(merged.get("metadata", {}).get("host_pids") or [1])
+    host = [
+        e for e in events
+        if e.get("ph") == "X" and e.get("pid") in host_pids
+    ]
+    device = [
+        e for e in events
+        if e.get("ph") == "X" and e.get("pid") not in host_pids
+    ]
     instants = [e for e in events if e.get("ph") == "i"]
     by_name_ms: Dict[str, float] = {}
     for e in host:
@@ -246,7 +260,9 @@ def summarize_timeline(
         "events": [
             {
                 "name": str(e.get("name"))[:80],
-                "source": "host" if e.get("pid") == 1 else "device",
+                "source": (
+                    "host" if e.get("pid") in host_pids else "device"
+                ),
                 "ts_ms": round(float(e.get("ts", 0.0)) / 1e3, 3),
                 "dur_ms": round(float(e.get("dur", 0.0)) / 1e3, 3),
             }

@@ -25,15 +25,19 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional
+
+from distributeddeeplearning_tpu.obs import recorder as _recorder_mod
 
 __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "merge_states",
     "summarize",
     "get_registry",
     "set_registry",
@@ -56,6 +60,11 @@ class Counter:
 
     def inc(self, n: int = 1) -> None:
         self.value += n
+        rec = _recorder_mod._RECORDER
+        if rec is not None and rec.enabled:
+            # metric deltas ride the flight-recorder ring (one bounded
+            # append; the value is a host int by construction)
+            rec.record_metric(self.name, self.value)
 
 
 class Gauge:
@@ -71,6 +80,9 @@ class Gauge:
     def set(self, value: float) -> None:
         self.value = float(value)  # sync-ok: host scalar coercion
         self.updated_at = time.time()
+        rec = _recorder_mod._RECORDER
+        if rec is not None and rec.enabled:
+            rec.record_metric(self.name, self.value)
 
 
 class Histogram:
@@ -179,6 +191,14 @@ class Histogram:
         return out
 
     def merge(self, other: "Histogram") -> None:
+        """EXACT bucket-wise merge: because both histograms share one
+        bucketing function, ``a.merge(b)`` produces bucket-for-bucket the
+        same sketch as recording every raw sample of both into one
+        histogram — so fleet-level percentiles computed from merged
+        worker buckets equal the single-process answer, which averaging
+        per-worker percentiles never does.  Commutative and associative
+        (merge order cannot change the result); mismatched error bounds
+        refuse instead of silently mixing incompatible grids."""
         if other._log_base != self._log_base:
             raise ValueError("cannot merge histograms with different error bounds")
         for idx, n in other._buckets.items():
@@ -190,6 +210,40 @@ class Histogram:
 
     def snapshot(self) -> Dict[str, Any]:
         return {"count": self.count, **self.summary()}
+
+    # -- mergeable wire form ----------------------------------------------
+    def state(self) -> Dict[str, Any]:
+        """JSON-safe full state (buckets included, underflow keyed "u")
+        — the wire form fleet workers ship so the router can rebuild and
+        bucket-merge exactly, not approximate from percentiles."""
+        return {
+            "name": self.name,
+            "max_rel_err": self.max_rel_err,
+            "count": self.count,
+            "total": self.total,
+            "min": None if math.isinf(self.min) else self.min,
+            "max": None if math.isinf(self.max) else self.max,
+            "buckets": {
+                "u" if idx is None else str(idx): n
+                for idx, n in self._buckets.items()
+            },
+        }
+
+    @classmethod
+    def from_state(cls, state: Dict[str, Any]) -> "Histogram":
+        h = cls(
+            state.get("name", ""),
+            float(state.get("max_rel_err", 0.01)),
+        )
+        h.count = int(state["count"])
+        h.total = float(state["total"])
+        h.min = math.inf if state["min"] is None else float(state["min"])
+        h.max = -math.inf if state["max"] is None else float(state["max"])
+        h._buckets = {
+            None if k == "u" else int(k): int(n)
+            for k, n in state.get("buckets", {}).items()
+        }
+        return h
 
 
 def summarize(xs, max_rel_err: float = 0.01) -> Dict[str, float]:
@@ -208,13 +262,37 @@ class MetricsRegistry:
     name), so instrumentation sites don't coordinate construction.
     """
 
-    def __init__(self):
+    def __init__(
+        self,
+        *,
+        replica_id: Optional[int] = None,
+        process_name: Optional[str] = None,
+    ):
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
+        # process identity: every snapshot row / shipped state carries it,
+        # so fleet JSONL streams are attributable (and the OBS_FLEET
+        # schema can reject anonymous per-replica rows)
+        self.replica_id = replica_id
+        self.process_name = process_name
         self.snapshots_written = 0
         self.snapshots_dropped = 0
+
+    def set_identity(
+        self,
+        *,
+        replica_id: Optional[int] = None,
+        process_name: Optional[str] = None,
+    ) -> "MetricsRegistry":
+        """Stamp this process's identity (fleet workers call it once at
+        spawn) — it rides every snapshot row and shipped state."""
+        if replica_id is not None:
+            self.replica_id = replica_id
+        if process_name is not None:
+            self.process_name = process_name
+        return self
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -235,10 +313,16 @@ class MetricsRegistry:
             return self._histograms[name]
 
     def snapshot(self, **extra: Any) -> Dict[str, Any]:
-        """One JSON-ready row of everything the process has recorded."""
+        """One JSON-ready row of everything the process has recorded.
+
+        Rows carry process identity (``pid`` always; ``replica_id`` /
+        ``process`` when stamped) so a fleet's interleaved JSONL stream
+        stays attributable — an anonymous row used to be indistinguishable
+        across workers."""
         with self._lock:
-            return {
+            row: Dict[str, Any] = {
                 "ts": time.time(),
+                "pid": os.getpid(),
                 "counters": {n: c.value for n, c in self._counters.items()},
                 "gauges": {
                     n: g.value for n, g in self._gauges.items()
@@ -247,8 +331,58 @@ class MetricsRegistry:
                 "histograms": {
                     n: h.snapshot() for n, h in self._histograms.items()
                 },
-                **extra,
             }
+            if self.replica_id is not None:
+                row["replica_id"] = self.replica_id
+            if self.process_name is not None:
+                row["process"] = self.process_name
+            row.update(extra)
+            return row
+
+    def state(self) -> Dict[str, Any]:
+        """Full mergeable state: counters/gauges plus EVERY histogram's
+        buckets (not just its percentile summary) — what fleet workers
+        ship over the outbox so the router computes fleet percentiles
+        from bucket-merged sketches, never by averaging per-replica
+        percentiles."""
+        with self._lock:
+            state: Dict[str, Any] = {
+                "pid": os.getpid(),
+                "ts": time.time(),
+                "counters": {n: c.value for n, c in self._counters.items()},
+                "gauges": {
+                    n: {"value": g.value, "updated_at": g.updated_at}
+                    for n, g in self._gauges.items()
+                    if g.value is not None
+                },
+                "histograms": {
+                    n: h.state() for n, h in self._histograms.items()
+                },
+            }
+            if self.replica_id is not None:
+                state["replica_id"] = self.replica_id
+            if self.process_name is not None:
+                state["process"] = self.process_name
+            return state
+
+    def merge_state(self, state: Dict[str, Any]) -> "MetricsRegistry":
+        """Fold one shipped :meth:`state` into this registry: counters
+        add, gauges keep the freshest ``updated_at``, histograms merge
+        bucket-wise (exact — see :meth:`Histogram.merge`)."""
+        for name, value in state.get("counters", {}).items():
+            self.counter(name).value += int(value)
+        for name, g in state.get("gauges", {}).items():
+            gauge = self.gauge(name)
+            at = g.get("updated_at") or 0.0
+            if gauge.updated_at is None or at >= gauge.updated_at:
+                gauge.value = g.get("value")
+                gauge.updated_at = at
+        for name, hstate in state.get("histograms", {}).items():
+            incoming = Histogram.from_state(hstate)
+            self.histogram(
+                name, max_rel_err=incoming.max_rel_err
+            ).merge(incoming)
+        return self
 
     def write_snapshot(self, path: str, **extra: Any) -> bool:
         """Append one snapshot row to ``path`` (JSONL), best-effort.
@@ -279,6 +413,16 @@ class MetricsRegistry:
             return False
         self.snapshots_written += 1
         return True
+
+
+def merge_states(states: Iterable[Dict[str, Any]]) -> MetricsRegistry:
+    """Merge shipped registry states into one fleet-level registry —
+    merge order cannot change the result (counter addition and bucket
+    addition are commutative/associative; gauges resolve by timestamp)."""
+    merged = MetricsRegistry(process_name="fleet-merged")
+    for state in states:
+        merged.merge_state(state)
+    return merged
 
 
 # -- process-global registry ----------------------------------------------

@@ -16,6 +16,12 @@ committed revision artifact:
   (merged timeline digest + decode phase breakdown + regression
   attribution), since the whole point of OBS_r11 is that downstream
   work (ROADMAP Open item 2) can script against it;
+- ``OBS_FLEET_*`` artifacts (checked before the ``OBS_`` prefix, which
+  they also match) validate against the fleet-observability schema:
+  merged-timeline digest, a failover chain that is traceable under one
+  trace id, bucket-merged fleet percentile blocks with sample counts,
+  ATTRIBUTABLE per-replica metric rows (anonymous rows rejected), the
+  SLO verdict and the four gate booleans;
 - ``SERVE_RESILIENCE_*`` artifacts validate against the serving chaos
   schema (clean/faulted FleetReport pair, gate booleans, fleet timeline
   event digest) — the evidence the fleet's failover story rests on;
@@ -33,6 +39,7 @@ __all__ = [
     "SchemaError",
     "validate_artifact",
     "validate_obs_payload",
+    "validate_obs_fleet_payload",
     "validate_serve_resilience_payload",
     "validate_spec_payload",
 ]
@@ -147,6 +154,141 @@ def validate_obs_payload(payload: Dict[str, Any]) -> None:
         )
     else:
         require(False, "regression_attribution must be a dict")
+
+    if errors:
+        raise SchemaError("; ".join(errors))
+
+
+def validate_obs_fleet_payload(payload: Dict[str, Any]) -> None:
+    """Strict schema for the ``OBS_FLEET_r{NN}.json`` artifact body.
+
+    The fleet-observability evidence trail: a chaos run where the
+    injected failover is traceable under one trace id in the MERGED
+    timeline, fleet percentiles are bucket-merged (with the exactness
+    check recorded), every per-replica metrics row carries process
+    identity (anonymous fleet rows rejected HERE), and the SLO layer's
+    pass/fail booleans travel with the numbers they gate.
+    """
+    errors: List[str] = []
+
+    def require(cond: bool, msg: str) -> None:
+        if not cond:
+            errors.append(msg)
+
+    for key in ("metric", "value", "unit", "bench_revision", "platform",
+                "virtual_pod", "faults_spec", "replicas", "timeline",
+                "failover", "fleet_latency", "per_replica_metrics",
+                "slo", "gates", "fleet_report"):
+        require(key in payload, f"missing top-level key {key!r}")
+
+    timeline = payload.get("timeline")
+    if isinstance(timeline, dict):
+        counts = timeline.get("event_counts")
+        require(
+            isinstance(counts, dict)
+            and isinstance(counts.get("host_spans"), int)
+            and counts["host_spans"] > 0,
+            "timeline.event_counts.host_spans must be a positive int "
+            "(the shard merge lost the worker spans)",
+        )
+    else:
+        require(False, "timeline must be a dict")
+
+    failover = payload.get("failover")
+    if isinstance(failover, dict) and failover:
+        for tid, chain in failover.items():
+            require(
+                isinstance(chain, dict)
+                and isinstance(chain.get("ok"), bool)
+                and isinstance(chain.get("chain"), list)
+                and len(chain["chain"]) > 0,
+                f"failover[{tid!r}] must carry ok + a non-empty chain",
+            )
+        require(
+            any(
+                isinstance(c, dict) and c.get("ok") is True
+                for c in failover.values()
+            ),
+            "no failover chain shows the full admit -> death -> requeue "
+            "-> survivor-completion shape",
+        )
+    else:
+        require(False, "failover must be a non-empty dict (one entry per "
+                       "requeued trace id)")
+
+    latency = payload.get("fleet_latency")
+    if isinstance(latency, dict):
+        require(
+            isinstance(latency.get("ttft_samples"), int)
+            and latency["ttft_samples"] > 0,
+            "fleet_latency.ttft_samples must be a positive int (no "
+            "merged TTFT buckets means the metric shipping broke)",
+        )
+        for block in ("ttft_s", "tpot_s"):
+            require(
+                isinstance(latency.get(block), dict)
+                and isinstance(latency[block].get("p99"), (int, float)),
+                f"fleet_latency.{block} must be a percentile block",
+            )
+    else:
+        require(False, "fleet_latency must be a dict")
+
+    per_replica = payload.get("per_replica_metrics")
+    if isinstance(per_replica, list) and per_replica:
+        for i, row in enumerate(per_replica):
+            require(
+                isinstance(row, dict)
+                and isinstance(row.get("pid"), int)
+                and isinstance(row.get("replica_id"), int),
+                f"per_replica_metrics[{i}] is ANONYMOUS — fleet metric "
+                "rows must carry pid and replica_id",
+            )
+    else:
+        require(False, "per_replica_metrics must be a non-empty list")
+
+    slo = payload.get("slo")
+    if isinstance(slo, dict):
+        require(
+            isinstance(slo.get("pass"), bool),
+            "slo.pass must be a bool",
+        )
+        criteria = slo.get("criteria")
+        if isinstance(criteria, dict) and criteria:
+            for name, c in criteria.items():
+                require(
+                    isinstance(c, dict) and isinstance(c.get("ok"), bool),
+                    f"slo.criteria[{name!r}].ok must be a bool",
+                )
+        else:
+            require(False, "slo.criteria must be a non-empty dict")
+    else:
+        require(False, "slo must be a dict")
+
+    gates = payload.get("gates")
+    if isinstance(gates, dict):
+        for gk in ("failover_traceable", "percentiles_merge_exact",
+                   "zero_lost_requests", "slo_pass"):
+            require(
+                isinstance(gates.get(gk), bool),
+                f"gates.{gk} must be a bool",
+            )
+    else:
+        require(False, "gates must be a dict")
+
+    rep = payload.get("fleet_report")
+    if isinstance(rep, dict):
+        for key in ("replicas", "requests", "replica_deaths", "restarts",
+                    "redeliveries", "lost_requests", "finish_reasons",
+                    "trace_ids", "fleet_latency"):
+            require(key in rep, f"fleet_report missing key {key!r}")
+        require(
+            isinstance(rep.get("replica_deaths"), int)
+            and rep.get("replica_deaths", 0) > 0,
+            "an OBS_FLEET artifact must come from a chaos run (no "
+            "replica death means no failover to trace)",
+        )
+    else:
+        require(False, "fleet_report must be a dict")
 
     if errors:
         raise SchemaError("; ".join(errors))
@@ -329,7 +471,14 @@ def validate_artifact(path: str) -> Any:
     import os
 
     base = os.path.basename(path)
-    if base.startswith("OBS_") and isinstance(data, dict):
+    if base.startswith("OBS_FLEET_") and isinstance(data, dict):
+        # checked FIRST: OBS_FLEET_* also matches the OBS_ prefix, but it
+        # is a different contract (fleet merge, not decode attribution)
+        try:
+            validate_obs_fleet_payload(data)
+        except SchemaError as exc:
+            errors.append(str(exc))
+    elif base.startswith("OBS_") and isinstance(data, dict):
         try:
             validate_obs_payload(data)
         except SchemaError as exc:

@@ -33,20 +33,30 @@ Usage::
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from distributeddeeplearning_tpu.obs import recorder as _recorder_mod
+from distributeddeeplearning_tpu.obs.recorder import (
+    FlightRecorder,
+    _RecorderSpan,
+)
+
 __all__ = [
     "Tracer",
+    "PROCESS_RECORDER",
     "get_tracer",
     "set_tracer",
     "configure",
 ]
 
-# Synthetic pid for host-side spans in the exported Chrome trace; device
-# traces use their own pids, so the merged view keeps the rows apart.
-HOST_PID = 1
+#: sentinel recorder binding: "whatever the PROCESS recorder currently
+#: is", resolved at record time — so ``set_recorder`` swaps (tests,
+#: resets) take effect on the global tracer immediately instead of
+#: leaving it bound to the recorder that existed at import
+PROCESS_RECORDER: Any = object()
 
 
 class _NullSpan:
@@ -103,20 +113,33 @@ class _Span:
         tracer._depth_local.depth = depth - 1
         if self._annotation is not None:
             self._annotation.__exit__(*exc)
-        args = dict(self._args) if self._args else {}
+        ctx = tracer._context
+        args = {**ctx, **self._args} if ctx else (
+            dict(self._args) if self._args else {}
+        )
         args["depth"] = depth - 1  # 0 = top-level: span nesting, testable
         tracer._events.append(
             {
                 "ph": "X",
                 "name": self._name,
                 "cat": self._cat,
-                "pid": HOST_PID,
+                "pid": tracer.pid,
                 "tid": threading.get_ident() & 0xFFFFFFFF,
                 "ts": (self._t0 - tracer._epoch_perf) * 1e6,
                 "dur": (t1 - self._t0) * 1e6,
                 "args": args,
             }
         )
+        rec = tracer._recorder
+        if rec is PROCESS_RECORDER:
+            rec = _recorder_mod._RECORDER
+        if rec is not None and rec.enabled:
+            # the flight recorder shadows the enabled tracer too: the ring
+            # must hold the LAST spans regardless of which driver is on
+            rec.record(
+                "span", self._name, self._cat, self._t0,
+                (t1 - self._t0) * 1e6, self._args,
+            )
 
 
 class Tracer:
@@ -128,15 +151,36 @@ class Tracer:
     same tracer.
     """
 
-    def __init__(self, *, enabled: bool = False, annotate: bool = True):
+    def __init__(
+        self,
+        *,
+        enabled: bool = False,
+        annotate: bool = True,
+        pid: Optional[int] = None,
+        process_name: Optional[str] = None,
+        recorder: Optional[FlightRecorder] = None,
+    ):
         self._enabled = enabled
         self._annotate_requested = annotate
         self._annotate = False
         self._trace_annotation = None
+        # pid/process_name derive from the EXPORTING process (the old
+        # hardcoded pid-1 interleaved every fleet worker's spans into one
+        # track when shards merged); ``process_name`` overrides for
+        # replica naming (``replica-3`` instead of ``ddlt-host``)
+        self.pid = int(pid) if pid is not None else os.getpid()
+        self.process_name = (
+            process_name if process_name is not None else "ddlt-host"
+        )
+        # default args stamped onto every span/event (fleet workers set
+        # replica=k so every scheduler span carries its replica identity)
+        self._context: Dict[str, Any] = {}
+        self._recorder = recorder
         self._events: List[Dict[str, Any]] = []
         self._depth_local = threading.local()
         # epoch pair: perf_counter for span math, wall clock so merged
-        # timelines can be stamped in absolute time
+        # timelines can be stamped in absolute time (and so fleet shards
+        # can be aligned onto the router clock)
         self._epoch_perf = time.perf_counter()
         self._epoch_wall = time.time()
         if enabled:
@@ -160,6 +204,12 @@ class Tracer:
     def enabled(self) -> bool:
         return self._enabled
 
+    @property
+    def epoch_unix_s(self) -> float:
+        """Wall-clock time of this tracer's perf_counter epoch — the
+        anchor fleet shard merging aligns worker clocks with."""
+        return self._epoch_wall
+
     def enable(self) -> "Tracer":
         self._enabled = True
         self._resolve_annotation()
@@ -172,29 +222,59 @@ class Tracer:
     def clear(self) -> None:
         self._events = []
 
+    def set_context(self, **args: Any) -> "Tracer":
+        """Merge default args stamped onto every subsequent span/event —
+        the fleet worker sets ``replica=k`` once instead of threading it
+        through every instrumentation site."""
+        self._context.update(args)
+        return self
+
+    def attach_recorder(
+        self, recorder: Optional[FlightRecorder]
+    ) -> "Tracer":
+        """Attach (or detach with None) a flight recorder: spans/events
+        then land in its ring even while the tracer is disabled."""
+        self._recorder = recorder
+        return self
+
     # -- recording --------------------------------------------------------
     def span(self, name: str, cat: str = "host", **args):
-        """Context manager timing a host-side phase.  Disabled tracer:
-        returns the shared no-op span (no clock read, no allocation)."""
-        if not self._enabled:
-            return _NULL_SPAN
-        return _Span(self, name, cat, args)
+        """Context manager timing a host-side phase.  Disabled tracer
+        without a recorder: the shared no-op span (no clock read, no
+        allocation).  With a flight recorder attached the disabled path
+        hands out the recorder's lightweight span instead — one ring
+        append, still zero-sync (lint-pinned)."""
+        if self._enabled:
+            return _Span(self, name, cat, args)
+        rec = self._recorder
+        if rec is PROCESS_RECORDER:
+            rec = _recorder_mod._RECORDER
+        if rec is not None and rec.enabled:
+            return _RecorderSpan(rec, name, cat, args)
+        return _NULL_SPAN
 
     def event(self, name: str, cat: str = "host", **args) -> None:
         """Instant event (Chrome ``"i"``): watchdog trips, preemptions,
-        anomaly detections — point-in-time marks on the same timeline."""
+        anomaly detections — point-in-time marks on the same timeline.
+        Recorded into the attached flight recorder even when disabled."""
+        rec = self._recorder
+        if rec is PROCESS_RECORDER:
+            rec = _recorder_mod._RECORDER
+        if rec is not None and rec.enabled:
+            rec.record_event(name, cat, args)
         if not self._enabled:
             return
+        ctx = self._context
         self._events.append(
             {
                 "ph": "i",
                 "s": "t",  # thread-scoped instant
                 "name": name,
                 "cat": cat,
-                "pid": HOST_PID,
+                "pid": self.pid,
                 "tid": threading.get_ident() & 0xFFFFFFFF,
                 "ts": (time.perf_counter() - self._epoch_perf) * 1e6,
-                "args": dict(args),
+                "args": {**ctx, **args} if ctx else dict(args),
             }
         )
 
@@ -205,13 +285,18 @@ class Tracer:
 
     def to_chrome_trace(self) -> Dict[str, Any]:
         """The ``{"traceEvents": [...]}`` Chrome/Perfetto container, with
-        process metadata naming the host lane."""
+        process metadata naming the host lane.  pid/process_name come
+        from THIS process (a fleet worker's shard renders as its own
+        track when merged — the old hardcoded pid collapsed every
+        exporting process into one), and ``metadata.host_pids`` records
+        which pids are host-tracer lanes so the merge/digest layers never
+        have to guess from magic numbers."""
         meta = [
             {
                 "ph": "M",
                 "name": "process_name",
-                "pid": HOST_PID,
-                "args": {"name": "ddlt-host"},
+                "pid": self.pid,
+                "args": {"name": self.process_name},
             }
         ]
         return {
@@ -220,6 +305,8 @@ class Tracer:
             "metadata": {
                 "tracer_epoch_unix_s": self._epoch_wall,
                 "clock": "perf_counter us since tracer epoch",
+                "host_pids": [self.pid],
+                "process_name": self.process_name,
             },
         }
 
@@ -231,8 +318,13 @@ class Tracer:
 
 
 # -- process-global tracer (disabled by default) --------------------------
+# The process tracer carries the process flight recorder (resolved
+# dynamically via the sentinel, so set_recorder swaps apply): spans and
+# events on the global tracer land in the bounded ring even while
+# tracing is off — that ring is what the watchdog/quarantine/death
+# dumps freeze.
 
-_TRACER = Tracer(enabled=False)
+_TRACER = Tracer(enabled=False, recorder=PROCESS_RECORDER)
 
 
 def get_tracer() -> Tracer:
@@ -247,6 +339,18 @@ def set_tracer(tracer: Tracer) -> Tracer:
     return tracer
 
 
-def configure(*, enabled: bool, annotate: bool = True) -> Tracer:
-    """Install a fresh tracer with the given switches and return it."""
-    return set_tracer(Tracer(enabled=enabled, annotate=annotate))
+def configure(
+    *,
+    enabled: bool,
+    annotate: bool = True,
+    pid: Optional[int] = None,
+    process_name: Optional[str] = None,
+) -> Tracer:
+    """Install a fresh tracer with the given switches and return it (the
+    process flight recorder stays attached, resolved dynamically)."""
+    return set_tracer(
+        Tracer(
+            enabled=enabled, annotate=annotate, pid=pid,
+            process_name=process_name, recorder=PROCESS_RECORDER,
+        )
+    )

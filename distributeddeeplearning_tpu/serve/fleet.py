@@ -61,7 +61,13 @@ import signal
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
-from distributeddeeplearning_tpu.obs.registry import get_registry, summarize
+from distributeddeeplearning_tpu.obs.fleet import fleet_latency
+from distributeddeeplearning_tpu.obs.recorder import get_recorder
+from distributeddeeplearning_tpu.obs.registry import (
+    get_registry,
+    merge_states,
+    summarize,
+)
 from distributeddeeplearning_tpu.obs.trace import get_tracer
 from distributeddeeplearning_tpu.serve.scheduler import (
     CompletedRequest,
@@ -113,6 +119,12 @@ class ReplicaSpec:
     max_new_tokens: int = 32
     request_deadline_s: Optional[float] = None
     watchdog_deadline_s: Optional[float] = None
+    # distributed tracing: when set, every worker enables its own tracer
+    # (pid/process_name derived from the worker, replica context stamped
+    # on every span) and exports a Chrome-trace SHARD here —
+    # ``replica{K}-{pid}.trace.json`` — for obs.fleet.merge_fleet_trace
+    # to align onto the router clock
+    trace_dir: Optional[str] = None
 
     def __post_init__(self):
         if self.kv_layout not in ("paged", "dense"):
@@ -155,6 +167,24 @@ class FleetReport:
     # final ServeReport dict per replica index for replicas that exited
     # cleanly (a dead-and-not-restarted replica leaves None)
     replica_reports: List[Optional[Dict[str, Any]]] = dataclasses.field(
+        default_factory=list
+    )
+    # distributed tracing: the trace id minted for each uid at intake —
+    # the correlation key the merged fleet timeline groups by
+    trace_ids: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # mergeable metrics: the raw per-worker-incarnation registry states
+    # (histogram buckets included) shipped over the outbox, the merged
+    # fleet snapshot, and the fleet-level TTFT/TPOT percentile blocks
+    # computed from BUCKET-merged histograms (never averaged percentiles)
+    replica_metric_states: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
+    fleet_metrics: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    fleet_latency: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # flight-recorder dumps: router-side (replica deaths it observed) +
+    # worker-side (injected deaths, quarantines, unhandled exceptions,
+    # shipped over the outbox before the process died)
+    flight_recorder_dumps: List[Dict[str, Any]] = dataclasses.field(
         default_factory=list
     )
 
@@ -241,6 +271,23 @@ def _build_engine(spec: ReplicaSpec):
     return engine
 
 
+#: how often a worker ships its full registry state over the outbox (the
+#: periodic half of "periodic + at drain" — a replica that dies between
+#: ships loses at most this window of counter movement)
+METRICS_SHIP_INTERVAL_S = 0.5
+
+
+def _ship_metrics(outbox, replica_id: int) -> None:
+    """Ship this worker's full mergeable registry state to the router.
+
+    Registered hot region (``fleet-worker-metrics-ship`` in
+    ``analysis/regions.py``, sync budget 0): the state is host counters
+    and histogram buckets by construction — a device value appearing on
+    this path means engine state leaked into the metrics plane.
+    """
+    outbox.put(("metrics", replica_id, os.getpid(), get_registry().state()))
+
+
 def _worker_main(
     replica_id: int,
     spec: ReplicaSpec,
@@ -257,25 +304,75 @@ def _worker_main(
     OVER the inherited environment (every worker inherits the parent's
     full ``DDLT_FAULTS``; without :func:`faults.install_plan` each would
     fire every serve-side entry at its own local step).
+
+    Observability: the worker stamps its identity on the metrics
+    registry (every snapshot row attributable), periodically ships its
+    mergeable registry state (plus a final ship at drain/death), and —
+    with ``spec.trace_dir`` set — runs its own tracer (worker pid +
+    ``replica-K`` process name, ``replica`` context on every span) and
+    exports a Chrome-trace shard at exit, at injected death, and on an
+    unhandled exception, so the merged fleet timeline keeps the dying
+    replica's last spans.
     """
     plan = faults_mod.install_plan(faults_spec or "")
 
+    from distributeddeeplearning_tpu.obs import trace as trace_mod
     from distributeddeeplearning_tpu.serve.scheduler import (
         ContinuousBatchingScheduler,
     )
+
+    get_registry().set_identity(
+        replica_id=replica_id, process_name=f"replica-{replica_id}",
+    )
+    tracer = trace_mod.get_tracer()
+    shard_path = None
+    if spec.trace_dir:
+        tracer = trace_mod.configure(
+            enabled=True, annotate=False,
+            process_name=f"replica-{replica_id}",
+        ).set_context(replica=replica_id)
+        shard_path = os.path.join(
+            spec.trace_dir,
+            f"replica{replica_id}-{os.getpid()}.trace.json",
+        )
+
+    def export_shard() -> None:
+        """Best-effort shard write — called on every exit path (normal,
+        injected death, crash); a failed write must not mask the exit."""
+        if shard_path is None:
+            return
+        try:
+            tracer.export(shard_path)
+        except OSError:
+            logger.warning("replica %d failed to write trace shard",
+                           replica_id)
+
+    def ship_dumps() -> None:
+        dumps = get_recorder().drain_dumps()
+        if dumps:
+            outbox.put(("dumps", replica_id, dumps))
 
     try:
         engine = _build_engine(spec)
     except Exception as exc:  # noqa: BLE001 — report, then exit visibly
         outbox.put(("spawn_error", replica_id, f"{type(exc).__name__}: {exc}"))
         return
-    outbox.put(("ready", replica_id, time.time()))
+    # ready doubles as the clock HANDSHAKE: the worker reports its tracer
+    # epoch (wall clock) + send time; the router turns that into a
+    # per-worker clock-offset estimate for the shard merge (send->receive
+    # delay bounds the estimate's error)
+    outbox.put(("ready", replica_id, {
+        "pid": os.getpid(),
+        "epoch_unix_s": tracer.epoch_unix_s,
+        "sent_unix_s": time.time(),
+    }))
 
     closed = False
     last_hb = 0.0
+    last_ship = 0.0
 
     def poll() -> Optional[List[Request]]:
-        nonlocal closed, last_hb
+        nonlocal closed, last_hb, last_ship
         # rate-limited liveness signal from the LOOP TOP, not just after
         # decode steps: without it a worker grinding through a long
         # chunked-prefill phase (each chunk's first-time compile blocks
@@ -288,6 +385,13 @@ def _worker_main(
         if now - last_hb > 0.25:
             last_hb = now
             outbox.put(("hb", replica_id, -1))
+        if now - last_ship > METRICS_SHIP_INTERVAL_S:
+            # the periodic metric ship rides the same loop-top cadence:
+            # full registry state (histogram buckets included) so the
+            # router's fleet percentiles stay bucket-merged, and a death
+            # between ships costs one interval of movement, not the run
+            last_ship = now
+            _ship_metrics(outbox, replica_id)
         if closed:
             return None
         fresh: List[Request] = []
@@ -305,6 +409,7 @@ def _worker_main(
                     prompt=msg["prompt"],
                     max_new_tokens=msg.get("max_new_tokens"),
                     deadline_s=msg.get("deadline_s"),
+                    trace_id=msg.get("trace_id"),
                 )
             )
         return None if (closed and not fresh) else fresh
@@ -313,6 +418,18 @@ def _worker_main(
         outbox.put(("hb", replica_id, step))
         if plan and plan.take_replica_death(step):
             # hard death, mid-service: no drain, no goodbye message.  The
+            # injected death IS observable inside the worker, so the
+            # black box gets flushed first: flight-recorder dump + final
+            # metrics state onto the wire, trace shard to disk — then
+            # os._exit, exactly as before.  (A REAL crash skips all of
+            # this; the router-side recorder still dumps on detection.)
+            get_recorder().dump(
+                "replica_death (injected)", registry=get_registry(),
+                replica=replica_id, step=step,
+            )
+            ship_dumps()
+            _ship_metrics(outbox, replica_id)
+            export_shard()
             # flush below only models "bytes already on the wire arrive"
             # (mp.Queue writes through a feeder thread; os._exit would
             # drop its buffer) — correctness does not depend on it, a
@@ -348,8 +465,22 @@ def _worker_main(
             on_complete=on_complete,
         )
     except BaseException as exc:  # noqa: BLE001 — visible death > silent
+        # unhandled worker exception: freeze the black box and ship it
+        # before the process dies — the non-zero exit code remains the
+        # authoritative death signal
+        get_recorder().dump(
+            "worker_exception", registry=get_registry(),
+            replica=replica_id, error=f"{type(exc).__name__}: {exc}",
+        )
+        ship_dumps()
+        export_shard()
         outbox.put(("crash", replica_id, f"{type(exc).__name__}: {exc}"))
         raise
+    # the drain half of "periodic + at drain": the final state carries
+    # the scheduler's end-of-run histogram rollup (TTFT/TPOT buckets)
+    _ship_metrics(outbox, replica_id)
+    ship_dumps()
+    export_shard()
     outbox.put(("exit", replica_id, report.to_dict()))
 
 
@@ -388,6 +519,10 @@ class _Flight:
 
     req: Request
     submitted_at: float
+    # the distributed-tracing correlation id minted at router intake —
+    # rides every delivery to every replica, so the whole lifecycle
+    # (including failovers) groups under ONE id in the merged timeline
+    trace_id: str = ""
     # absolute (router-clock) deadline: fixed at submit so a redelivery
     # ships only the REMAINING window — re-basing would grant each
     # failover a fresh full deadline
@@ -456,6 +591,17 @@ class FleetRouter:
         self.redeliveries = 0
         self.lost_requests = 0
         self.shed_seen = 0
+        # handshake clock-offset estimates, keyed by worker pid: the
+        # ready message carries the worker tracer's wall-clock epoch, so
+        # the shard merge can align each worker's perf_counter timeline
+        # onto the router clock (obs.fleet.merge_fleet_trace)
+        self.clock_offsets_us: Dict[int, float] = {}
+        # latest shipped registry state per worker INCARNATION (replica
+        # index, pid) — states are cumulative per process, so last wins;
+        # a restarted replica's fresh pid keeps its predecessor's final
+        # shipped state in the merge instead of overwriting it
+        self._metric_states: Dict[tuple, Dict[str, Any]] = {}
+        self._worker_dumps: List[Dict[str, Any]] = []
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -510,6 +656,7 @@ class FleetRouter:
         workers down gracefully.
         """
         trace = get_tracer()
+        router_epoch_unix_s = trace.epoch_unix_s
         t_start = time.perf_counter()
         self._members = [
             self._spawn(i, self._dealt[i]) for i in range(self.replicas)
@@ -519,7 +666,7 @@ class FleetRouter:
         results: List[CompletedRequest] = []
         finish_reasons: Dict[str, int] = {}
         now = time.perf_counter()
-        for req in requests:
+        for i, req in enumerate(requests):
             if req.uid in flights:
                 raise ValueError(f"duplicate request uid {req.uid!r}")
             if _SEP in req.uid:
@@ -535,9 +682,19 @@ class FleetRouter:
             flights[req.uid] = _Flight(
                 req=req,
                 submitted_at=now,
+                # trace id minted at ROUTER INTAKE (honoring a caller-
+                # supplied one): the single correlation key every
+                # delivery, every worker span and every recovery event
+                # carries — distinct from the uid so propagation, not
+                # coincidence, is what the merged timeline shows
+                trace_id=req.trace_id or f"tr{i:04d}",
                 deadline_at=(
                     now + deadline_s if deadline_s is not None else None
                 ),
+            )
+            trace.event(
+                "fleet/request_admitted", cat="fleet", uid=req.uid,
+                trace=flights[req.uid].trace_id,
             )
             backlog.append(req.uid)
 
@@ -620,6 +777,7 @@ class FleetRouter:
                 self.lost_requests += 1
                 trace.event(
                     "fleet/request_lost", cat="fleet", uid=uid, reason=why,
+                    trace=fl.trace_id,
                 )
                 finalize(uid, {
                     "tokens": [],
@@ -634,7 +792,7 @@ class FleetRouter:
             trace.event(
                 "fleet/request_requeued", cat="fleet", uid=uid,
                 reason=why, preserved_tokens=len(fl.preserved),
-                delivery=fl.delivery,
+                delivery=fl.delivery, trace=fl.trace_id,
             )
             backlog.append(uid)
 
@@ -650,6 +808,11 @@ class FleetRouter:
             )
             member.inbox.put({
                 "uid": fl.wire_uid(),
+                # the trace id crosses the wire WITH the delivery: the
+                # worker's scheduler tags every span/event for this
+                # request with it, whichever replica (or redelivery)
+                # ends up serving it
+                "trace_id": fl.trace_id,
                 # failover continuation: everything already streamed rides
                 # in the prompt; greedy decode then reproduces the
                 # fault-free stream exactly (decode == full forward)
@@ -722,8 +885,27 @@ class FleetRouter:
                 # informational: the non-zero exit code is the reliable
                 # death signal (the process is mid-raise right now)
                 logger.warning("replica %d crash: %s", rid, msg[2])
+            elif kind == "metrics":
+                # latest mergeable registry state per worker incarnation
+                # (cumulative per process — last wins; a restarted
+                # replica's new pid is a NEW incarnation, so the dead
+                # one's final state stays in the fleet merge)
+                self._metric_states[(rid, msg[2])] = msg[3]
+            elif kind == "dumps":
+                # flight-recorder dumps the worker shipped before dying
+                # (injected death / quarantine / unhandled exception)
+                self._worker_dumps.extend(msg[2])
             elif kind == "ready" and member is not None:
                 member.ready = True
+                hs = msg[2]
+                if isinstance(hs, dict) and "epoch_unix_s" in hs:
+                    # clock handshake: worker tracer epoch (wall clock)
+                    # vs the router's — the per-shard offset estimate
+                    # the fleet trace merge aligns with; the send->recv
+                    # delay bounds how stale the estimate can be
+                    self.clock_offsets_us[hs.get("pid")] = (
+                        float(hs["epoch_unix_s"]) - router_epoch_unix_s
+                    ) * 1e6
             # "hb" carries no payload beyond liveness, handled above
 
         def drain_burst(budget_s: float = 0.3) -> None:
@@ -741,16 +923,28 @@ class FleetRouter:
             member.dead = True
             self.replica_deaths += 1
             drain_burst()  # harvest the pipe before committing streams
+            orphans = sorted(member.outstanding)
             trace.event(
                 "fleet/replica_died", cat="fleet", replica=member.index,
                 how=how, outstanding=len(member.outstanding),
                 restarts_used=member.restarts_used,
+                # the orphaned trace ids ride the death event, so a
+                # per-trace chain in the merged timeline contains the
+                # death that interrupted it (failover_chains groups on
+                # these alongside per-request `trace` tags)
+                trace_ids=[flights[uid].trace_id for uid in orphans],
+            )
+            # black-box trigger: freeze the ROUTER's recent view (fleet
+            # events, dispatch spans, metric movements) at the moment the
+            # death was observed — attached to the FleetReport
+            get_recorder().dump(
+                "replica_death", registry=get_registry(),
+                replica=member.index, how=how, orphans=len(orphans),
             )
             logger.warning(
                 "replica %d died (%s) with %d request(s) in flight",
                 member.index, how, len(member.outstanding),
             )
-            orphans = sorted(member.outstanding)
             member.outstanding.clear()
             for uid in orphans:
                 redeliver(
@@ -807,6 +1001,7 @@ class FleetRouter:
                     trace.event(
                         "fleet/request_lost", cat="fleet", uid=uid,
                         reason="no live replica",
+                        trace=flights[uid].trace_id,
                     )
                     finalize(uid, {
                         "tokens": [], "finish_reason": "error",
@@ -929,15 +1124,30 @@ class FleetRouter:
             if member.proc.exitcode is None:
                 member.proc.terminate()
                 member.proc.join(timeout=5.0)
-        while True:  # buffered trailing exit reports
+        # Trailing messages: the dispatch loop exits the moment the last
+        # RESULT lands, but each worker's drain-time payload — its exit
+        # report, its FINAL metrics state (the one carrying the
+        # scheduler's end-of-run TTFT/TPOT histogram rollup) and any
+        # flight-recorder dumps — arrives after that, during shutdown.
+        # Dropping them here would leave the fleet merge with only the
+        # mid-run periodic ships.
+        while True:
             try:
-                msg = self._outbox.get_nowait()
+                # short timeout, not get_nowait: the workers have exited,
+                # but the router-side queue thread may still be
+                # deserializing their final flush — one idle window
+                # bounds the wait
+                msg = self._outbox.get(timeout=0.25)
             except queue_mod.Empty:
                 break
             if msg[0] == "exit":
                 for member in self._members:
                     if member.index == msg[1] and member.report is None:
                         member.report = msg[2]
+            elif msg[0] == "metrics":
+                self._metric_states[(msg[1], msg[2])] = msg[3]
+            elif msg[0] == "dumps":
+                self._worker_dumps.extend(msg[2])
 
         wall = time.perf_counter() - t_start
         ok = [r for r in results if r.finish_reason in ("eos", "length")]
@@ -949,6 +1159,16 @@ class FleetRouter:
             for r in ok
             if len(r.tokens) >= 2
         ]
+        # fleet-level metrics: merge every worker incarnation's LAST
+        # shipped registry state bucket-wise — the percentiles below are
+        # exactly what one process recording every worker's samples
+        # would report (obs.fleet.fleet_latency is THE one reader of
+        # the merge, so the report and the obs layer cannot drift)
+        metric_states = [
+            self._metric_states[key] for key in sorted(self._metric_states)
+        ]
+        merged_registry = merge_states(metric_states)
+        router_dumps = get_recorder().drain_dumps()
         report = FleetReport(
             replicas=self.replicas,
             requests=len(flights),
@@ -970,6 +1190,13 @@ class FleetRouter:
             shed=self.shed_seen,
             drained=self._drain_event.is_set(),
             replica_reports=[m.report for m in self._members],
+            trace_ids={
+                uid: fl.trace_id for uid, fl in flights.items()
+            },
+            replica_metric_states=metric_states,
+            fleet_metrics=merged_registry.snapshot(),
+            fleet_latency=fleet_latency(merged_registry),
+            flight_recorder_dumps=router_dumps + self._worker_dumps,
         )
         reg = get_registry()
         reg.counter("fleet.replica_deaths").inc(self.replica_deaths)

@@ -94,6 +94,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from distributeddeeplearning_tpu.obs.recorder import get_recorder
 from distributeddeeplearning_tpu.obs.registry import (
     Histogram,
     get_registry,
@@ -109,12 +110,19 @@ class Request:
     """One generation request: a token-id prompt plus an optional
     per-request token budget (falls back to the scheduler default) and an
     optional deadline (seconds from intake; falls back to the scheduler's
-    ``request_deadline_s``)."""
+    ``request_deadline_s``).
+
+    ``trace_id`` is the distributed-tracing correlation id the fleet
+    router mints at intake and carries across the worker boundary: every
+    request-scoped span/event the scheduler emits is tagged with it, so
+    a failover (death on one replica, completion on another) reads as
+    ONE chain in the merged fleet timeline."""
 
     uid: str
     prompt: Sequence[int]
     max_new_tokens: Optional[int] = None
     deadline_s: Optional[float] = None
+    trace_id: Optional[str] = None
 
 
 #: terminal states a request can reach (``CompletedRequest.finish_reason``)
@@ -436,6 +444,12 @@ class ContinuousBatchingScheduler:
         step_hist = Histogram("serve.decode_step_s")
         draft_hist = Histogram("serve.draft_step_s")
         verify_hist = Histogram("serve.verify_step_s")
+        # process-registry latency histograms, fed per completion (see
+        # finish()); bound once so the completion path pays no registry
+        # lock per request
+        _reg = get_registry()
+        ttft_registry_hist = _reg.histogram("serve.ttft_s")
+        tpot_registry_hist = _reg.histogram("serve.tpot_s")
         occ_sum = 0.0
         occ_n = 0               # attempted decode steps (incl. failed)
         n_decode_steps = 0      # exact count
@@ -474,6 +488,23 @@ class ContinuousBatchingScheduler:
             finish_reasons[result.finish_reason] = (
                 finish_reasons.get(result.finish_reason, 0) + 1
             )
+            # latency histograms feed the PROCESS registry per completion,
+            # not in an end-of-run rollup: a fleet worker killed mid-run
+            # has already recorded every request it finished, so the
+            # periodic metric ship carries those buckets home and the
+            # fleet percentiles keep the dead replica's completions.
+            # (Failures with no tokens carry a hardcoded ttft_s=0.0 and
+            # would drag the histogram toward 0 — same filters the
+            # report blocks use.)
+            if result.tokens:
+                ttft_registry_hist.record(result.ttft_s)
+            if len(result.tokens) >= 2 and result.finish_reason not in (
+                "cancelled", "preempted",
+            ):
+                tpot_registry_hist.record(
+                    (result.total_s - result.ttft_s)
+                    / (len(result.tokens) - 1)
+                )
             if pop_meta:
                 # the uid is terminal: its cross-delivery bookkeeping is
                 # dead weight from here on (a long-lived live loop would
@@ -522,6 +553,7 @@ class ContinuousBatchingScheduler:
             trace.event(
                 "serve/request_complete", uid=st.req.uid, reason=reason,
                 tokens=len(m.preserved) + len(st.generated), ttft_s=st.ttft_s,
+                trace=st.req.trace_id,
             )
             del active[slot]
             release(slot)  # paged: pages back to the pool
@@ -578,6 +610,7 @@ class ContinuousBatchingScheduler:
                 error_count += 1
             trace.event(
                 "serve/request_failed", uid=req.uid, reason=reason,
+                trace=req.trace_id,
             )
 
         def activate(
@@ -719,6 +752,7 @@ class ContinuousBatchingScheduler:
                 uid=st.req.uid,
                 prompt=list(st.req.prompt) + list(st.generated),
                 max_new_tokens=st.budget - len(st.generated),
+                trace_id=st.req.trace_id,
             )
             del active[slot]
             release(slot)
@@ -726,7 +760,7 @@ class ContinuousBatchingScheduler:
             pending.appendleft(retry)
             trace.event(
                 "serve/request_requeued", uid=st.req.uid, reason=why,
-                preserved_tokens=len(m.preserved),
+                preserved_tokens=len(m.preserved), trace=st.req.trace_id,
             )
 
         pending: deque = deque()
@@ -883,6 +917,7 @@ class ContinuousBatchingScheduler:
                             with trace.span(
                                 "serve/admit", uid=req.uid,
                                 prompt_len=len(req.prompt),
+                                trace=req.trace_id,
                             ):
                                 task = engine.prefill_begin(
                                     slot, req.prompt, budget
@@ -898,6 +933,7 @@ class ContinuousBatchingScheduler:
                         with trace.span(
                             "serve/prefill", uid=req.uid,
                             prompt_len=len(req.prompt),
+                            trace=req.trace_id,
                         ):
                             first = engine.prefill(slot, req.prompt)
                     except Exception as exc:  # noqa: BLE001 — isolate per request
@@ -932,7 +968,7 @@ class ContinuousBatchingScheduler:
                         try:
                             with trace.span(
                                 "serve/prefill_chunk", uid=req.uid,
-                                offset=task.offset,
+                                offset=task.offset, trace=req.trace_id,
                             ):
                                 first = engine.prefill_step(task)
                         except Exception as exc:  # noqa: BLE001 — per-request
@@ -1069,7 +1105,15 @@ class ContinuousBatchingScheduler:
                             scrub(slot, len(st.req.prompt))
                         trace.event(
                             "serve/request_quarantined", uid=st.req.uid,
-                            step=decode_step,
+                            step=decode_step, trace=st.req.trace_id,
+                        )
+                        # black-box trigger: freeze the flight-recorder
+                        # ring (the last-N spans/events/metric deltas
+                        # BEFORE the poison surfaced) — the fleet worker
+                        # ships these dumps home with its report
+                        get_recorder().dump(
+                            "decode_quarantine", registry=get_registry(),
+                            uid=st.req.uid, step=decode_step,
                         )
                         finished.append((
                             slot, st, "error",
@@ -1218,14 +1262,8 @@ class ContinuousBatchingScheduler:
         reg.counter("serve.errors").inc(error_count)
         reg.counter("serve.decode_retries").inc(decode_retries)
         reg.counter("serve.quarantined").inc(quarantined)
-        # cancelled/errored/step_cap-cut requests never produced a first
-        # token and carry a hardcoded ttft_s=0.0 — recording them would
-        # drag the cross-run histogram toward 0 on every smoke or fault
-        # run (tpot and queue_wait above filter failures too)
-        reg.histogram("serve.ttft_s").record_many(
-            [r.ttft_s for r in results if r.tokens]
-        )
-        reg.histogram("serve.tpot_s").record_many(tpot)
+        # ttft/tpot histograms were fed per completion in finish() —
+        # recording them again here would double-count every request
         reg.histogram("serve.decode_step_s").merge(step_hist)
         reg.gauge("serve.tokens_per_sec").set(report.tokens_per_sec)
         reg.gauge("serve.decode_tokens_per_sec").set(

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Callable, Optional, Tuple
 
+from distributeddeeplearning_tpu.obs.recorder import get_recorder
 from distributeddeeplearning_tpu.obs.trace import get_tracer
 
 logger = logging.getLogger("ddlt.resilience")
@@ -313,6 +314,14 @@ class StepWatchdog:
                 "resilience/watchdog_fired", cat="resilience",
                 step=last_step, stalled_s=round(elapsed, 3),
                 deadline_s=self.deadline_s,
+            )
+            # freeze the flight recorder BEFORE the stack dump: the ring
+            # holds the last spans/events/metric deltas leading into the
+            # stall — the first thing the post-mortem wants next to the
+            # stacks (a fleet worker's supervisor collects the dump list)
+            get_recorder().dump(
+                "watchdog_fired", step=last_step,
+                stalled_s=round(elapsed, 3), deadline_s=self.deadline_s,
             )
             stream = self._stream if self._stream is not None else sys.stderr
             print(

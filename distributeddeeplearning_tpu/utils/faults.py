@@ -203,6 +203,17 @@ class FaultPlan:
         logger.warning(
             "FAULT INJECTED: %s at step %s (%s)", spec.describe(), step, site
         )
+        # every injected fault lands in the flight-recorder ring too, so
+        # a dump triggered moments later shows the injection next to its
+        # consequences (lazy import: faults is a leaf utility)
+        try:
+            from distributeddeeplearning_tpu.obs.recorder import get_recorder
+
+            get_recorder().record_event(
+                f"fault/{spec.kind}", "fault", {"step": step, "site": site}
+            )
+        except Exception:  # pragma: no cover - recording must never fault
+            pass
 
     def _take_step_keyed(self, kind: str, step: int) -> Optional[FaultSpec]:
         """Consume the one-shot step-keyed ``kind`` fault for ``step``."""

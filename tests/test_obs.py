@@ -125,10 +125,10 @@ def test_merge_host_device_aligns_clocks(tmp_path):
         e for e in merged["traceEvents"] if e.get("name") == "fusion.1"
     )
     assert fusion["ts"] == pytest.approx(5002.0 + offset)
-    # host spans untouched, on pid 1
+    # host spans untouched, on the tracer's own (derived) pid
     host = next(
         e for e in merged["traceEvents"]
-        if e.get("name") == "shared_phase" and e.get("pid") == 1
+        if e.get("name") == "shared_phase" and e.get("pid") == t.pid
     )
     assert host["ts"] == pytest.approx(host_ts)
 

@@ -1,0 +1,757 @@
+"""Fleet-scale observability (ISSUE 11): distributed tracing, mergeable
+metrics, the crash flight recorder and the SLO layer.
+
+Covers the OBS_FLEET contract:
+
+- ``Histogram.merge`` is EXACT: merged percentiles property-tested
+  against numpy on the concatenated raw samples across skewed
+  distributions, bucket-identical to a single histogram over the
+  concatenation, and merge-order invariant;
+- registry states round-trip/merge (counters add, gauges resolve by
+  freshness) and every snapshot row carries process identity;
+- trace shards export with per-process pids and merge onto the router
+  clock within tolerance under synthetic skew, with the failover chain
+  ORDERED in the merged timeline;
+- the flight recorder stays on with the tracer disabled, is bounded,
+  and dumps on quarantine / watchdog / (fleet test) replica death;
+- SLO parse/evaluate pass + violation cases;
+- OBS_FLEET schema rejection cases (anonymous per-replica rows, missing
+  failover evidence);
+- one real 2-replica chaos fleet end-to-end through
+  ``obs.fleet.observe_fleet`` — the in-process half of the bench gate.
+"""
+
+import itertools
+import json
+import time
+
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.obs import fleet as obs_fleet
+from distributeddeeplearning_tpu.obs import recorder as recorder_mod
+from distributeddeeplearning_tpu.obs.recorder import FlightRecorder
+from distributeddeeplearning_tpu.obs.registry import (
+    Histogram,
+    MetricsRegistry,
+    merge_states,
+)
+from distributeddeeplearning_tpu.obs.trace import Tracer
+
+
+@pytest.fixture()
+def fresh_recorder():
+    """Isolate the process flight recorder; restore afterwards."""
+    prior = recorder_mod._RECORDER
+    rec = recorder_mod.set_recorder(FlightRecorder(capacity=64))
+    yield rec
+    recorder_mod.set_recorder(prior)
+
+
+# --------------------------------------------------------------------------
+# Histogram.merge: exactness, numpy property tests, order invariance
+# --------------------------------------------------------------------------
+
+
+_DISTRIBUTIONS = {
+    "lognormal_heavy": np.random.default_rng(0).lognormal(0.0, 2.0, 6000),
+    "uniform": np.random.default_rng(1).uniform(1e-4, 50.0, 6000),
+    "bimodal_skew": np.concatenate([
+        np.random.default_rng(2).exponential(0.001, 5000),
+        np.random.default_rng(3).normal(100.0, 5.0, 200).clip(min=1.0),
+    ]),
+    "constant": np.full(777, 0.125),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_DISTRIBUTIONS))
+def test_merged_percentiles_match_numpy_on_concatenated_samples(name):
+    """The property the fleet depends on: shard the samples across
+    'workers', merge the sketches, and the percentiles must match numpy
+    over the CONCATENATED raw samples as well as a single unsharded
+    sketch does — merging loses nothing."""
+    samples = _DISTRIBUTIONS[name]
+    shards = np.array_split(samples, 5)
+    merged = Histogram()
+    for shard in shards:
+        h = Histogram()
+        h.record_many(shard)
+        merged.merge(h)
+    single = Histogram()
+    single.record_many(samples)
+    for q in (50, 90, 99):
+        want = float(np.percentile(samples, q))
+        got = merged.percentile(q)
+        # the sketch's own 1% bound + interpolation-convention slack —
+        # identical to what the UNSHARDED sketch is held to
+        assert got == pytest.approx(want, rel=0.03), (name, q, got, want)
+        assert got == single.percentile(q), (name, q)
+    assert merged.count == single.count == len(samples)
+    assert merged.max == pytest.approx(float(samples.max()))
+    assert merged.mean == pytest.approx(float(samples.mean()), rel=1e-9)
+
+
+def test_merge_is_bucket_exact_and_order_invariant():
+    rng = np.random.default_rng(7)
+    parts = [rng.lognormal(0.0, 1.5, 400) for _ in range(4)]
+    hists = []
+    for part in parts:
+        h = Histogram()
+        h.record_many(part)
+        hists.append(h)
+    single = Histogram()
+    single.record_many(np.concatenate(parts))
+    summaries = set()
+    for perm in itertools.permutations(range(4)):
+        merged = Histogram()
+        for i in perm:
+            merged.merge(hists[i])
+        assert merged._buckets == single._buckets  # bucket-for-bucket
+        summaries.add(json.dumps(merged.summary(), sort_keys=True))
+    assert len(summaries) == 1  # every merge order: identical answer
+
+
+def test_merge_refuses_mismatched_error_bounds():
+    a, b = Histogram(max_rel_err=0.01), Histogram(max_rel_err=0.05)
+    b.record(1.0)
+    with pytest.raises(ValueError, match="error bounds"):
+        a.merge(b)
+
+
+def test_histogram_state_roundtrip_preserves_buckets_exactly():
+    h = Histogram("ttft", max_rel_err=0.02)
+    h.record_many([0.0, 1e-6, 0.5, 0.5, 3.25, 100.0])
+    clone = Histogram.from_state(
+        json.loads(json.dumps(h.state()))  # through the JSON wire
+    )
+    assert clone._buckets == h._buckets
+    assert clone.summary() == h.summary()
+    assert (clone.count, clone.total, clone.min, clone.max) == (
+        h.count, h.total, h.min, h.max,
+    )
+
+
+def test_empty_histogram_state_roundtrip():
+    clone = Histogram.from_state(Histogram("empty").state())
+    assert clone.count == 0 and clone.summary()["p99"] == 0.0
+
+
+# --------------------------------------------------------------------------
+# registry: identity on rows, mergeable states
+# --------------------------------------------------------------------------
+
+
+def test_snapshot_rows_carry_process_identity(tmp_path):
+    """The satellite: fleet JSONL streams must be attributable — every
+    row carries pid, and replica identity once stamped."""
+    import os
+
+    reg = MetricsRegistry()
+    reg.counter("c").inc()
+    row = reg.snapshot()
+    assert row["pid"] == os.getpid()
+    assert "replica_id" not in row  # unstamped single-process registry
+    reg.set_identity(replica_id=3, process_name="replica-3")
+    path = str(tmp_path / "obs.jsonl")
+    assert reg.write_snapshot(path)
+    written = json.loads(open(path).read())
+    assert written["pid"] == os.getpid()
+    assert written["replica_id"] == 3
+    assert written["process"] == "replica-3"
+
+
+def test_registry_states_merge_counters_gauges_histograms():
+    a = MetricsRegistry(replica_id=0)
+    b = MetricsRegistry(replica_id=1)
+    a.counter("serve.requests").inc(3)
+    b.counter("serve.requests").inc(5)
+    a.gauge("occ").set(0.25)
+    time.sleep(0.01)
+    b.gauge("occ").set(0.75)  # fresher: must win either merge order
+    a.histogram("serve.ttft_s").record_many([0.1, 0.2])
+    b.histogram("serve.ttft_s").record_many([0.3, 0.4])
+    for states in ([a.state(), b.state()], [b.state(), a.state()]):
+        merged = merge_states(states)
+        assert merged.counter("serve.requests").value == 8
+        assert merged.gauge("occ").value == 0.75
+        assert merged.histogram("serve.ttft_s").count == 4
+        assert merged.histogram("serve.ttft_s").max == 0.4
+
+
+def test_fleet_latency_reads_bucket_merged_histograms():
+    # a fast busy replica and a small slow one: the merged p99 must see
+    # the slow replica's tail (sorted rank 103 of 104 lands in the 9.0
+    # block), where averaging per-replica p99s would answer ~4.5 — the
+    # construction distinguishes bucket-merging from averaging
+    a = MetricsRegistry()
+    a.histogram(obs_fleet.TTFT_HISTOGRAM).record_many([0.1] * 100)
+    b = MetricsRegistry()
+    b.histogram(obs_fleet.TTFT_HISTOGRAM).record_many([9.0] * 4)
+    merged = merge_states([a.state(), b.state()])
+    lat = obs_fleet.fleet_latency(merged)
+    assert lat["ttft_samples"] == 104
+    assert lat["ttft_s"]["p99"] == pytest.approx(9.0, rel=0.05)
+    assert lat["ttft_s"]["p50"] == pytest.approx(0.1, rel=0.05)
+
+
+# --------------------------------------------------------------------------
+# trace shards: derived pids, skew alignment, chain ordering
+# --------------------------------------------------------------------------
+
+
+def test_tracer_derives_pid_and_accepts_replica_naming():
+    import os
+
+    t = Tracer(enabled=True, annotate=False)
+    assert t.pid == os.getpid()
+    named = Tracer(
+        enabled=True, annotate=False, pid=4242, process_name="replica-7",
+    )
+    with named.span("x"):
+        pass
+    exported = named.to_chrome_trace()
+    meta = [e for e in exported["traceEvents"] if e.get("ph") == "M"]
+    assert meta[0]["pid"] == 4242
+    assert meta[0]["args"]["name"] == "replica-7"
+    assert exported["metadata"]["host_pids"] == [4242]
+    assert all(
+        e["pid"] == 4242
+        for e in exported["traceEvents"]
+        if e.get("ph") == "X"
+    )
+
+
+def test_tracer_context_stamps_every_span_and_event():
+    t = Tracer(enabled=True, annotate=False).set_context(replica=3)
+    with t.span("s", uid="r1"):
+        pass
+    t.event("e")
+    for ev in t.events:
+        assert ev["args"]["replica"] == 3
+    assert t.events[0]["args"]["uid"] == "r1"  # explicit args kept
+
+
+def _synthetic_shard(pid, name, epoch_unix_s, events):
+    return {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": pid,
+             "args": {"name": name}},
+            *events,
+        ],
+        "metadata": {
+            "tracer_epoch_unix_s": epoch_unix_s,
+            "host_pids": [pid],
+            "process_name": name,
+        },
+    }
+
+
+def test_shards_with_known_skew_land_on_router_clock():
+    """The satellite pin: worker shards whose perf-counter epochs are
+    skewed by known amounts must land within tolerance on the router
+    clock after the merge (epoch alignment), and a handshake offset
+    must override the epoch estimate when provided."""
+    router = _synthetic_shard(10, "router", 1000.0, [
+        {"ph": "i", "s": "t", "name": "fleet/drain_begin", "pid": 10,
+         "tid": 1, "ts": 0.0, "args": {}},
+    ])
+    # worker epoch 2.5s after the router's: a local ts of 1000µs is
+    # really at router-time 2.501s
+    w1 = _synthetic_shard(20, "replica-0", 1002.5, [
+        {"ph": "X", "name": "serve/decode_step", "pid": 20, "tid": 1,
+         "ts": 1000.0, "dur": 5.0, "args": {}},
+    ])
+    merged = obs_fleet.merge_fleet_trace(router, [w1])
+    ev = next(
+        e for e in merged["traceEvents"]
+        if e.get("name") == "serve/decode_step"
+    )
+    assert ev["ts"] == pytest.approx(2.5e6 + 1000.0, abs=1.0)
+    assert merged["metadata"]["shards"][0]["offset_source"] == "epoch"
+    # explicit handshake estimate wins over the epoch difference
+    merged2 = obs_fleet.merge_fleet_trace(
+        router, [w1], offsets_us={20: 7.0e6},
+    )
+    ev2 = next(
+        e for e in merged2["traceEvents"]
+        if e.get("name") == "serve/decode_step"
+    )
+    assert ev2["ts"] == pytest.approx(7.0e6 + 1000.0, abs=1.0)
+    assert merged2["metadata"]["shards"][0]["offset_source"] == "handshake"
+
+
+def test_colliding_shard_pids_are_remapped_to_distinct_tracks():
+    """The satellite fix: two exports sharing a pid must NOT interleave
+    into one track after the merge."""
+    router = _synthetic_shard(10, "router", 1000.0, [])
+    w1 = _synthetic_shard(10, "replica-0", 1000.0, [  # colliding pid!
+        {"ph": "X", "name": "serve/a", "pid": 10, "tid": 1,
+         "ts": 1.0, "dur": 1.0, "args": {}},
+    ])
+    w2 = _synthetic_shard(10, "replica-1", 1000.0, [
+        {"ph": "X", "name": "serve/b", "pid": 10, "tid": 1,
+         "ts": 1.0, "dur": 1.0, "args": {}},
+    ])
+    merged = obs_fleet.merge_fleet_trace(router, [w1, w2])
+    a = next(e for e in merged["traceEvents"] if e.get("name") == "serve/a")
+    b = next(e for e in merged["traceEvents"] if e.get("name") == "serve/b")
+    assert a["pid"] != 10 and b["pid"] != 10  # neither stole the router's
+    assert a["pid"] != b["pid"]               # nor each other's
+    assert len(set(merged["metadata"]["host_pids"])) == 3
+
+
+def test_failover_chain_appears_ordered_after_alignment():
+    """Worker clocks skewed such that RAW timestamps would order the
+    survivor's completion BEFORE the death — after alignment the chain
+    reads admit -> died -> requeued -> completion, and the checker
+    recognizes the full failover shape."""
+    tid = "tr0003"
+    router = _synthetic_shard(10, "router", 1000.0, [
+        {"ph": "i", "s": "t", "name": "fleet/replica_died", "pid": 10,
+         "tid": 1, "ts": 3.0e6, "args": {"trace_ids": [tid]}},
+        {"ph": "i", "s": "t", "name": "fleet/request_requeued", "pid": 10,
+         "tid": 1, "ts": 3.1e6, "args": {"trace": tid}},
+    ])
+    # dying replica: served the request 1.5s in (router clock) — its
+    # local ts is only 0.5e6 because its epoch is 1s later
+    dying = _synthetic_shard(20, "replica-0", 1001.0, [
+        {"ph": "X", "name": "serve/admit", "pid": 20, "tid": 1,
+         "ts": 0.5e6, "dur": 10.0, "args": {"trace": tid}},
+    ])
+    # survivor: completes at router-time 3.5s; raw local ts 1.0e6 would
+    # sort BEFORE the death without alignment
+    survivor = _synthetic_shard(30, "replica-1", 1002.5, [
+        {"ph": "i", "s": "t", "name": "serve/request_complete", "pid": 30,
+         "tid": 1, "ts": 1.0e6, "args": {"trace": tid}},
+    ])
+    merged = obs_fleet.merge_fleet_trace(router, [dying, survivor])
+    chains = obs_fleet.failover_chains(merged, [tid])
+    chain = chains[tid]
+    assert [e["name"] for e in chain] == [
+        "serve/admit", "fleet/replica_died", "fleet/request_requeued",
+        "serve/request_complete",
+    ]
+    verdict = obs_fleet.check_failover_chain(chain)
+    assert verdict["ok"]
+    assert verdict["served_on_pid_before_death"] == [20]
+    assert verdict["completed_on_pid"] == 30
+    # and the negative: without the death the shape is NOT a failover
+    no_death = [e for e in chain if e["name"] != "fleet/replica_died"]
+    assert not obs_fleet.check_failover_chain(no_death)["ok"]
+
+
+# --------------------------------------------------------------------------
+# flight recorder
+# --------------------------------------------------------------------------
+
+
+def test_recorder_is_bounded_and_survives_disabled_tracer(fresh_recorder):
+    tracer = Tracer(
+        enabled=False, annotate=False, recorder=fresh_recorder,
+    )
+    for i in range(200):  # capacity is 64: the ring must stay bounded
+        with tracer.span("serve/decode_step", step=i):
+            pass
+    tracer.event("serve/request_complete", uid="r1")
+    assert tracer.events == []  # the TRACER recorded nothing...
+    assert len(fresh_recorder) == 64  # ...the black box everything recent
+    entries = fresh_recorder.entries()
+    assert entries[-1]["name"] == "serve/request_complete"
+    assert entries[-1]["kind"] == "event"
+    assert all(e["kind"] in ("span", "event") for e in entries)
+    assert fresh_recorder.records_total == 201
+
+
+def test_recorder_captures_metric_deltas(fresh_recorder):
+    reg = MetricsRegistry()
+    reg.counter("serve.errors").inc()
+    reg.gauge("serve.tokens_per_sec").set(42.0)
+    kinds = [(e["kind"], e["name"]) for e in fresh_recorder.entries()]
+    assert ("metric", "serve.errors") in kinds
+    assert ("metric", "serve.tokens_per_sec") in kinds
+    metric = [
+        e for e in fresh_recorder.entries()
+        if e["name"] == "serve.tokens_per_sec"
+    ][0]
+    assert metric["value"] == 42.0
+
+
+def test_recorder_dump_freezes_ring_and_attaches_metrics(fresh_recorder):
+    reg = MetricsRegistry()
+    reg.counter("c").inc(2)
+    fresh_recorder.record_event("serve/request_quarantined", "serve")
+    dump = fresh_recorder.dump("decode_quarantine", registry=reg, uid="r9")
+    assert dump["reason"] == "decode_quarantine"
+    assert dump["uid"] == "r9"
+    assert dump["metrics"]["counters"]["c"] == 2
+    assert any(
+        e["name"] == "serve/request_quarantined" for e in dump["entries"]
+    )
+    assert fresh_recorder.dumps == [dump]
+    drained = fresh_recorder.drain_dumps()
+    assert drained == [dump] and fresh_recorder.dumps == []
+
+
+def test_scheduler_feeds_latency_histograms_per_completion():
+    """The registry's TTFT/TPOT buckets are written as each request
+    finishes — NOT in an end-of-run rollup — so a fleet worker killed
+    mid-run has already recorded (and shipped) every completion.  Exactly
+    one sample per completed request: a second end-of-run pass would
+    double-count."""
+    from distributeddeeplearning_tpu.obs import registry as registry_mod
+    from distributeddeeplearning_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+
+    class _Engine:
+        batch_slots = 2
+        max_seq = 64
+        chunked_prefill = False
+        prefill_compiles = 0
+
+        def prefill(self, slot, prompt):
+            return 1
+
+        def decode(self, tokens, pos):
+            return np.full(2, 2, np.int32)
+
+    prior = registry_mod.get_registry()
+    reg = registry_mod.set_registry(registry_mod.MetricsRegistry())
+    try:
+        reqs = [Request(uid=f"r{i}", prompt=[1, 2]) for i in range(5)]
+        ContinuousBatchingScheduler(_Engine(), max_new_tokens=4).run(reqs)
+        assert reg.histogram("serve.ttft_s").count == 5
+        assert reg.histogram("serve.tpot_s").count == 5
+    finally:
+        registry_mod.set_registry(prior)
+
+
+def test_quarantine_triggers_recorder_dump(fresh_recorder):
+    """The scheduler's NaN quarantine is a flight-recorder trigger: the
+    dump lands even with the tracer fully disabled."""
+    from distributeddeeplearning_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+
+    class _NanEngine:
+        batch_slots = 2
+        max_seq = 64
+        chunked_prefill = False
+        prefill_compiles = 0
+
+        def __init__(self):
+            self.steps = 0
+            self.last_finite = np.ones(2, bool)
+
+        def prefill(self, slot, prompt):
+            return 1
+
+        def decode(self, tokens, pos):
+            self.steps += 1
+            self.last_finite = (
+                np.array([False, True])
+                if self.steps == 2 else np.ones(2, bool)
+            )
+            return np.full(2, 2, np.int32)
+
+    reqs = [Request(uid=f"r{i}", prompt=[1, 2]) for i in range(2)]
+    results, report = ContinuousBatchingScheduler(
+        _NanEngine(), max_new_tokens=4
+    ).run(reqs)
+    assert report.quarantined == 1
+    dumps = [
+        d for d in fresh_recorder.dumps
+        if d["reason"] == "decode_quarantine"
+    ]
+    assert len(dumps) == 1
+    assert dumps[0]["step"] == 2
+
+
+def test_watchdog_fire_triggers_recorder_dump(fresh_recorder):
+    from distributeddeeplearning_tpu.train.resilience import StepWatchdog
+
+    import io
+
+    fired = []
+    wd = StepWatchdog(
+        0.1, on_timeout=lambda: fired.append(True), poll_s=0.02,
+        stream=io.StringIO(),
+    ).start()
+    try:
+        wd.tick(7)
+        deadline = time.monotonic() + 5.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        wd.stop()
+    assert fired
+    dumps = [
+        d for d in fresh_recorder.dumps if d["reason"] == "watchdog_fired"
+    ]
+    assert len(dumps) == 1
+    assert dumps[0]["step"] == 7
+
+
+def test_injected_faults_land_in_recorder_ring(fresh_recorder):
+    from distributeddeeplearning_tpu.utils import faults as faults_mod
+
+    plan = faults_mod.FaultPlan(faults_mod.parse_spec("decode_stall@1:secs=0"))
+    assert plan.take_decode_stall(1) == 0.0
+    names = [e["name"] for e in fresh_recorder.entries()]
+    assert "fault/decode_stall" in names
+
+
+# --------------------------------------------------------------------------
+# SLO spec
+# --------------------------------------------------------------------------
+
+
+def test_slo_parse_roundtrip_and_rejects_unknown_keys():
+    slo = obs_fleet.SLOSpec.parse(
+        "ttft_p99_s=2.0,tpot_p99_s=0.5,max_error_rate=0.01,"
+        "max_lost_requests=0"
+    )
+    assert slo.ttft_p99_s == 2.0 and slo.max_lost_requests == 0
+    assert obs_fleet.SLOSpec.parse(slo.describe()) == slo
+    with pytest.raises(ValueError, match="unknown SLO key"):
+        obs_fleet.SLOSpec.parse("p99=1.0")
+    with pytest.raises(ValueError, match="key=value"):
+        obs_fleet.SLOSpec.parse("ttft_p99_s")
+
+
+def test_slo_evaluate_pass_and_violations():
+    slo = obs_fleet.SLOSpec(
+        ttft_p99_s=1.0, tpot_p99_s=0.2, max_error_rate=0.0,
+        max_lost_requests=0,
+    )
+    latency = {
+        "ttft_s": {"p99": 0.8}, "tpot_s": {"p99": 0.1},
+        "ttft_samples": 10, "tpot_samples": 10,
+    }
+    good = slo.evaluate(
+        fleet_report={"requests": 10, "errors": 0, "lost_requests": 0},
+        latency=latency,
+    )
+    assert good["pass"] and all(
+        c["ok"] for c in good["criteria"].values()
+    )
+    assert set(good["criteria"]) == {
+        "ttft_p99_s", "tpot_p99_s", "max_error_rate", "max_lost_requests",
+    }
+    # a latency breach, an error, a lost request: each flips its criterion
+    bad = slo.evaluate(
+        fleet_report={"requests": 10, "errors": 1, "lost_requests": 2},
+        latency={**latency, "ttft_s": {"p99": 3.0}},
+    )
+    assert not bad["pass"]
+    assert not bad["criteria"]["ttft_p99_s"]["ok"]
+    assert not bad["criteria"]["max_error_rate"]["ok"]
+    assert not bad["criteria"]["max_lost_requests"]["ok"]
+    assert bad["criteria"]["tpot_p99_s"]["ok"]
+
+
+def test_slo_with_no_samples_fails_latency_criteria_loudly():
+    """Zero merged samples means the metric shipping broke — an SLO over
+    a silent fleet must not read as met."""
+    slo = obs_fleet.SLOSpec(ttft_p99_s=10.0)
+    out = slo.evaluate(
+        fleet_report={"requests": 5, "errors": 0, "lost_requests": 0},
+        latency={"ttft_s": {"p99": 0.0}, "tpot_s": {}, "ttft_samples": 0,
+                 "tpot_samples": 0},
+    )
+    assert not out["criteria"]["ttft_p99_s"]["ok"]
+
+
+# --------------------------------------------------------------------------
+# OBS_FLEET schema
+# --------------------------------------------------------------------------
+
+
+def test_obs_fleet_schema_rejects_anonymous_rows_and_missing_failover():
+    from distributeddeeplearning_tpu.obs.schema import (
+        SchemaError,
+        validate_obs_fleet_payload,
+    )
+
+    with pytest.raises(SchemaError) as exc:
+        validate_obs_fleet_payload({})
+    assert "failover" in str(exc.value)
+
+    base = json.load(open("OBS_FLEET_r14.json"))
+    anonymous = json.loads(json.dumps(base))
+    anonymous["per_replica_metrics"][0].pop("replica_id")
+    with pytest.raises(SchemaError, match="ANONYMOUS"):
+        validate_obs_fleet_payload(anonymous)
+
+    no_chain = json.loads(json.dumps(base))
+    for c in no_chain["failover"].values():
+        c["ok"] = False
+    with pytest.raises(SchemaError, match="no failover chain"):
+        validate_obs_fleet_payload(no_chain)
+
+    peaceful = json.loads(json.dumps(base))
+    peaceful["fleet_report"]["replica_deaths"] = 0
+    with pytest.raises(SchemaError, match="chaos run"):
+        validate_obs_fleet_payload(peaceful)
+
+
+def test_committed_obs_fleet_artifact_passes_merge_exactness():
+    """Acceptance (b), against the COMMITTED artifact: the fleet
+    percentile blocks must be exactly reproducible by re-merging the
+    committed per-replica histogram buckets, in reversed order."""
+    d = json.load(open("OBS_FLEET_r14.json"))
+    recomputed = obs_fleet.fleet_latency(
+        merge_states(list(reversed(d["per_replica_metrics"])))
+    )
+    assert recomputed == d["fleet_latency"]
+    assert d["fleet_latency"]["ttft_samples"] > 0
+    assert all(d["gates"].values())
+
+
+# --------------------------------------------------------------------------
+# lint registration (the CI/tooling satellite)
+# --------------------------------------------------------------------------
+
+
+def test_recorder_and_metric_ship_paths_are_registered_hot_regions():
+    from distributeddeeplearning_tpu.analysis import host_sync
+    from distributeddeeplearning_tpu.analysis.regions import get_region
+
+    for name in (
+        "obs-recorder-record",
+        "obs-recorder-span-enter",
+        "obs-recorder-span-exit",
+        "fleet-worker-metrics-ship",
+    ):
+        region = get_region(name)
+        assert region.sync_budget == 0  # zero DESIGNED syncs, enforced
+        findings = host_sync.check_region(region)
+        assert not findings, (name, findings)
+
+
+# --------------------------------------------------------------------------
+# the real thing: a 2-replica chaos fleet, observed end to end
+# --------------------------------------------------------------------------
+
+
+FLEET_MODEL = dict(num_layers=1, d_model=16, num_heads=2, d_ff=32,
+                   vocab_size=97, max_len=32)
+
+
+@pytest.mark.timeout(280)
+def test_observe_fleet_end_to_end_chaos(tmp_path):
+    """ISSUE 11 acceptance (test half): a 2-replica fleet through
+    ``replica_death@3`` with tracing on — worker shards exported
+    (including by the DYING replica), merged onto the router clock, the
+    failover traceable under one trace id, fleet TTFT/TPOT bucket-merged
+    with samples, per-replica states attributable, and flight-recorder
+    dumps attached to the report."""
+    import glob
+    import os
+
+    from distributeddeeplearning_tpu.serve import (
+        ReplicaSpec,
+        synthetic_requests,
+    )
+
+    spec = ReplicaSpec(
+        model=FLEET_MODEL, seed=0, num_heads=2, batch_slots=2,
+        max_seq=32, kv_layout="paged", page_size=8, prefill_chunk=8,
+        max_new_tokens=8,
+    )
+    reqs = synthetic_requests(
+        8, vocab_size=FLEET_MODEL["vocab_size"], max_prompt=10,
+        rng=np.random.default_rng(0),
+    )
+    trace_dir = str(tmp_path / "fleet-trace")
+    slo = obs_fleet.SLOSpec.parse(
+        "ttft_p99_s=120,tpot_p99_s=30,max_error_rate=0,"
+        "max_lost_requests=0"
+    )
+    view = obs_fleet.observe_fleet(
+        spec, reqs, replicas=2, trace_dir=trace_dir,
+        faults="replica_death@3", slo=slo,
+    )
+    report = view["fleet_report"]
+    assert report.replica_deaths == 1
+    assert report.lost_requests == 0
+    assert sorted(r.uid for r in view["results"]) == sorted(
+        r.uid for r in reqs
+    )
+
+    # every uid got a distinct trace id, minted at the router
+    assert sorted(report.trace_ids) == sorted(r.uid for r in reqs)
+    assert len(set(report.trace_ids.values())) == len(reqs)
+
+    # shards: one per worker incarnation, INCLUDING the injected death's
+    shards = glob.glob(os.path.join(trace_dir, "replica*.trace.json"))
+    assert len(shards) >= 2
+    assert os.path.exists(view["merged_trace_path"])
+
+    # the failover is traceable end-to-end under one trace id
+    assert view["failover"], "no requeued trace ids found"
+    ok_chains = [t for t, c in view["failover"].items() if c["ok"]]
+    assert ok_chains, view["failover"]
+    chain = view["failover"][ok_chains[0]]["chain"]
+    names = [e["name"] for e in chain]
+    assert names.index("fleet/replica_died") < names.index(
+        "fleet/request_requeued"
+    ) < len(names) - 1 - names[::-1].index("serve/request_complete")
+
+    # mergeable metrics: bucket-merged fleet latency with real samples,
+    # exactly reproducible from the attributable per-replica states
+    assert view["fleet_latency"]["ttft_samples"] == len(reqs)
+    for row in view["per_replica_metrics"]:
+        assert isinstance(row["pid"], int)
+        assert isinstance(row["replica_id"], int)
+    recomputed = obs_fleet.fleet_latency(
+        merge_states(list(reversed(view["per_replica_metrics"])))
+    )
+    assert recomputed == view["fleet_latency"]
+    assert report.fleet_latency == view["fleet_latency"]
+
+    # flight recorder: the death dumped on BOTH sides of the boundary
+    reasons = {d["reason"] for d in view["flight_recorder_dumps"]}
+    assert "replica_death" in reasons            # router observed it
+    assert "replica_death (injected)" in reasons  # worker froze its ring
+
+    # SLO evaluated over the merged view
+    assert view["slo"]["pass"], view["slo"]
+    assert set(view["slo"]["criteria"]) == {
+        "ttft_p99_s", "tpot_p99_s", "max_error_rate", "max_lost_requests",
+    }
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(280)
+def test_bench_obs_fleet_smoke(tmp_path):
+    """``bench.py --obs-fleet --small`` end to end: schema-valid
+    OBS_FLEET artifact, all gates green, merged fleet trace on disk."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    from distributeddeeplearning_tpu.obs.schema import validate_artifact
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    report = tmp_path / "OBS_FLEET_r98.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DDLT_FAULTS", None)
+    proc = subprocess.run(
+        [
+            _sys.executable, os.path.join(repo, "bench.py"),
+            "--obs-fleet", "--small",
+            "--obs-fleet-requests", "8",
+            "--obs-fleet-new-tokens", "6",
+            "--report", str(report),
+            "--trace-dir", str(tmp_path / "trace"),
+        ],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=260,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    line = validate_artifact(str(report))
+    assert line["bench_revision"] >= 14
+    assert all(line["gates"].values())
+    assert os.path.exists(tmp_path / "trace" / "fleet.trace.json")
