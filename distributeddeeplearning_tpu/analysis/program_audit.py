@@ -147,6 +147,18 @@ class ProgramRecord:
     the lowered module must carry (0 = no donation expected); ``hot``
     arms the callback ban; ``int8_history_len`` arms the dtype audit with
     the full-history position count of the traced cache.
+
+    ``int8_head_dim`` arms the STRICT intermediate audit (the flash-
+    decode contract): no history-shaped float value — ``ndim >= 3``,
+    some dim ``>= int8_history_len``, trailing dim ``== int8_head_dim``
+    (the K/V-vector signature; scores/probabilities trail the position
+    dim and scale tensors trail the head dim, so neither matches) — may
+    be *produced by any equation* except the bare int8→float widening
+    that feeds a matmul operand.  The legacy gather+dequant programs
+    fail this (their scale multiply / own-token select / page reshape
+    all emit history-shaped floats), which is exactly why only the
+    flash-decode records arm it: the fused programs are the ones
+    contractually obliged to keep dequantized history out of existence.
     """
 
     name: str
@@ -155,6 +167,7 @@ class ProgramRecord:
     donate_min: int = 0
     hot: bool = True
     int8_history_len: Optional[int] = None
+    int8_head_dim: Optional[int] = None
 
     def location(self) -> Tuple[str, int]:
         return program_location(self.jitted)
@@ -287,6 +300,22 @@ def check_int8_history(rec: ProgramRecord, traced=None) -> List[Finding]:
                     "attention fusion; return the int8 cache + scales",
                 )
             )
+    def is_history_vector(aval) -> bool:
+        # the STRICT intermediate signature: a K/V-history-shaped float
+        # ([..., >=hist positions somewhere, head_dim last]).  Scores/
+        # probabilities trail the position dim, scale tensors trail the
+        # head count — neither matches, so the attention math itself
+        # stays legal while any materialized dequantized history trips.
+        shape = tuple(getattr(aval, "shape", ()))
+        dtype = getattr(aval, "dtype", None)
+        return (
+            dtype is not None
+            and jnp.issubdtype(dtype, jnp.floating)
+            and len(shape) >= 3
+            and shape[-1] == rec.int8_head_dim
+            and any(d >= hist for d in shape)
+        )
+
     saw_dequant = False
     for eqn, _ in iter_eqns(jaxpr):
         name = eqn.primitive.name
@@ -296,6 +325,44 @@ def check_int8_history(rec: ProgramRecord, traced=None) -> List[Finding]:
                 eqn.params.get("new_dtype", jnp.float32), jnp.floating
             ):
                 saw_dequant = True
+        if (
+            rec.int8_head_dim is not None
+            and name not in WRITE_PRIMITIVES
+            and name != "dot_general"
+            # a contraction RESULT is attention math, not stored history
+            # (its operands are what the surrounding checks police);
+            # every materialization form the gather path used — scale
+            # mul, own-token select, broadcast, page reshape — is an
+            # elementwise/layout op and stays banned
+        ):
+            # intermediate audit (flash-decode contract): the only eqn
+            # allowed to EMIT a history-shaped float is the bare
+            # int→float widening feeding a matmul read — scale
+            # multiplies, selects, broadcasts and page reshapes at
+            # history granularity are the materializations the fused
+            # kernel exists to delete.  Write primitives are handled by
+            # the dedicated WRITES check below.
+            widening = name == "convert_element_type" and jnp.issubdtype(
+                eqn.invars[0].aval.dtype, jnp.integer
+            )
+            if not widening:
+                for outvar in eqn.outvars:
+                    if is_history_vector(outvar.aval):
+                        findings.append(
+                            Finding(
+                                "dtype-audit", path, line,
+                                f"program {rec.name} materializes a "
+                                "history-shaped float intermediate "
+                                f"{tuple(outvar.aval.shape)} via "
+                                f"`{name}` — dequantized history exists "
+                                "inside the int8 decode program",
+                                hint="fold scales into the score/"
+                                "probability vectors (or dequantize "
+                                "in-tile inside the kernel); only the "
+                                "bare int8→float widening may touch "
+                                "history shapes",
+                            )
+                        )
         if name in WRITE_PRIMITIVES:
             for operand in eqn.invars[1:]:
                 if is_history_f32(operand.aval):
@@ -552,6 +619,11 @@ class _ServeFixture:
             d_ff=_FF, vocab_size=_V, max_len=_SEQ,
         )
         self.qparams = quantize_params(self.params)
+        # default engines resolve decode_kernel "auto" -> "flash": the
+        # registry audits the programs production serves with (on this
+        # cpu platform the fused-XLA twin; the int8 records arm the
+        # strict no-history-f32-intermediate audit those programs are
+        # contractually obliged to pass)
         kw = dict(num_heads=_H, batch_slots=_SLOTS, max_seq=_SEQ)
         self.dense_f32 = InferenceEngine(self.params, **kw)
         self.dense_int8 = InferenceEngine(
@@ -562,6 +634,18 @@ class _ServeFixture:
         self.paged_f32 = PagedInferenceEngine(self.params, **pkw)
         self.paged_int8 = PagedInferenceEngine(
             self.params, cache_dtype=jnp.int8, **pkw
+        )
+        # the legacy gather path stays registered (it remains selectable
+        # via --decode-kernel gather) under the ORIGINAL dtype audit:
+        # its history-granular dequant is its known, documented cost,
+        # so the strict intermediate check does not arm here
+        self.dense_int8_gather = InferenceEngine(
+            self.params, cache_dtype=jnp.int8, decode_kernel="gather",
+            **kw,
+        )
+        self.paged_int8_gather = PagedInferenceEngine(
+            self.params, cache_dtype=jnp.int8, decode_kernel="gather",
+            **pkw,
         )
 
 
@@ -639,14 +723,32 @@ def build_program_records() -> List[ProgramRecord]:
     # to what quantize_params actually produces, with no quant math run
     q_abs = abstract_quantized_params(p_abs)
 
+    # the strict no-history-f32-intermediate audit arms on the FLASH
+    # programs only (the fused-kernel contract); the gather variants keep
+    # the original output/write checks — their history-granular dequant
+    # is the documented cost the flash kernel exists to delete
+    _HD = _D // _H
+    # the history-vector signature (trailing dim == head_dim) relies on
+    # the audit dims keeping head_dim distinct from the head COUNT: a
+    # gathered scale tensor trails h, and h == hd would make legal
+    # scale tensors indistinguishable from materialized history — fail
+    # loudly here rather than with false findings on clean programs
+    assert _H != _HD, (
+        f"audit dims degenerate: num_heads ({_H}) == head_dim ({_HD}) — "
+        "the strict dtype audit's history-vector signature needs them "
+        "distinct; adjust _D/_H in program_audit.py"
+    )
+
     # dense engines ------------------------------------------------------
     for tag, engine, params_abs, int8_cache in (
         ("serve.dense.f32", fx.dense_f32, p_abs, False),
         ("serve.dense.int8", fx.dense_int8, p_abs, True),
         ("serve.dense.w_int8", fx.dense_w_int8, q_abs, False),
+        ("serve.dense.int8_gather", fx.dense_int8_gather, p_abs, True),
     ):
         c_abs = cache_abs(engine)
         kv = _sds((1, _L, 8, _H, _D // _H), jnp.float32)
+        flash = engine.decode_kernel == "flash"
         records += [
             ProgramRecord(
                 f"{tag}.prefill", engine._prefill_jit,
@@ -662,6 +764,7 @@ def build_program_records() -> List[ProgramRecord]:
                 (params_abs, c_abs, slot_vec, slot_vec, scalar),
                 donate_min=n_cache_leaves(engine),
                 int8_history_len=_SEQ if int8_cache else None,
+                int8_head_dim=_HD if (int8_cache and flash) else None,
             ),
             ProgramRecord(
                 f"{tag}.scrub", engine._scrub_jit,
@@ -677,21 +780,28 @@ def build_program_records() -> List[ProgramRecord]:
     for tag, engine, int8_cache in (
         ("serve.paged.f32", fx.paged_f32, False),
         ("serve.paged.int8", fx.paged_int8, True),
+        ("serve.paged.int8_gather", fx.paged_int8_gather, True),
     ):
         c_abs = cache_abs(engine)
         nleaves = n_cache_leaves(engine)
+        flash = engine.decode_kernel == "flash"
         records += [
             ProgramRecord(
+                # chunk width 4, deliberately != head_dim (8): with
+                # C == hd an einsum-internal [h, s, C] product would be
+                # indistinguishable from a [.., s, hd] history tensor
                 f"{tag}.prefill_chunk", engine._chunk_jit,
-                (p_abs, c_abs, _sds((1, _PAGE), i32), table1, scalar),
+                (p_abs, c_abs, _sds((1, 4), i32), table1, scalar),
                 donate_min=nleaves,
                 int8_history_len=_SEQ if int8_cache else None,
+                int8_head_dim=_HD if (int8_cache and flash) else None,
             ),
             ProgramRecord(
                 f"{tag}.decode", engine._decode_jit,
                 (p_abs, c_abs, slot_vec, slot_vec, tables, scalar, False),
                 donate_min=nleaves,
                 int8_history_len=_SEQ if int8_cache else None,
+                int8_head_dim=_HD if (int8_cache and flash) else None,
             ),
             ProgramRecord(
                 f"{tag}.scrub", engine._scrub_jit,

@@ -390,6 +390,17 @@ def build_parser() -> argparse.ArgumentParser:
     serve_p.add_argument("--no-prefix-cache", action="store_true",
                          help="disable shared-prefix page reuse "
                          "(--kv-layout paged)")
+    serve_p.add_argument("--decode-kernel", default="auto",
+                         choices=("auto", "flash", "gather"),
+                         help="how decode attention consumes the KV "
+                         "cache (ops/flash_decode.py): 'flash' streams "
+                         "cache pages through the paged flash-decode "
+                         "kernel (Pallas on TPU with in-tile int8 "
+                         "dequant — f32 history never materializes in "
+                         "HBM; a fused-XLA twin elsewhere, bitwise "
+                         "identical to gather for f32 caches); 'gather' "
+                         "is the legacy block-table-gather read; "
+                         "'auto' (default) = flash")
     serve_p.add_argument("--quantize-kv", default=None, choices=("int8",),
                          help="store the KV cache int8 with per-position-"
                          "per-head f32 scales (quant/): ~3.2x smaller KV "
@@ -1484,6 +1495,7 @@ def _cmd_serve(args) -> int:
             max_new_tokens=args.max_new_tokens,
             request_deadline_s=args.request_deadline_s,
             watchdog_deadline_s=args.watchdog_deadline_s,
+            decode_kernel=args.decode_kernel,
         )
         # validation (vocab / position-table clamp) is done with the
         # restored pytree; the workers restore their own copies, so
@@ -1578,6 +1590,7 @@ def _cmd_serve(args) -> int:
             cache_dtype=cache_dtype,
             rng=jax.random.key(args.seed),
             prefix_cache=not args.no_prefix_cache,
+            decode_kernel=args.decode_kernel,
         ), None
     elif args.speculative:
         # spec is single-mesh (the verify/rollback programs carry no
@@ -1594,6 +1607,7 @@ def _cmd_serve(args) -> int:
             top_k=args.top_k,
             cache_dtype=cache_dtype,
             rng=jax.random.key(args.seed),
+            decode_kernel=args.decode_kernel,
         ), None
     else:
         engine, mesh = data_parallel_engine(
@@ -1606,6 +1620,7 @@ def _cmd_serve(args) -> int:
             top_k=args.top_k,
             cache_dtype=cache_dtype,
             rng=jax.random.key(args.seed),
+            decode_kernel=args.decode_kernel,
         )
 
     spec_decoder = None

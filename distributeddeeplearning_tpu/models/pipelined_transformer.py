@@ -28,8 +28,8 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from distributeddeeplearning_tpu.ops import flash_decode as _fd
 from distributeddeeplearning_tpu.quant.qtensor import (
-    dequantize_kv as _dq_kv,
     qmatmul as _mm,
     quantize_kv as _q_kv,
     quantized_cache,
@@ -263,7 +263,8 @@ def forward_prefill(
     return _mm(x, params["head"]), jnp.moveaxis(k, 0, 1), jnp.moveaxis(v, 0, 1)
 
 
-def _block_decode(p, x, k_l, v_l, pos, *, num_heads: int, k_s=None, v_s=None):
+def _block_decode(p, x, k_l, v_l, pos, *, num_heads: int, k_s=None, v_s=None,
+                  kernel: str = "gather"):
     """One block's single-token decode against its cache layer.
 
     ``x``: [B, d] residual stream for the current token of every slot;
@@ -271,17 +272,22 @@ def _block_decode(p, x, k_l, v_l, pos, *, num_heads: int, k_s=None, v_s=None):
     position each slot's current token occupies.  The new token's K/V are
     scattered into the cache *before* attention (each slot at its own
     position — slots decode at unequal depths under continuous batching),
-    then attention runs dense against positions ``<= pos``.  Exactly
+    then attention runs against positions ``<= pos`` through
+    :mod:`ops.flash_decode` (``kernel="gather"`` is the legacy dense
+    read, ``"flash"`` the fused kernel/twin).  Exactly
     :func:`block_apply`'s math restricted to one query row.
 
     ``k_s``/``v_s`` ([B, S, h] f32, int8 cache only): per-position-per-
     head scales.  The new token's K/V quantize on write (values + their
-    own scales), and attention reads the DEQUANTIZED view — the multiply
-    fuses into the score/context einsums, so the int8 cache costs one
-    broadcast multiply, not a materialized f32 copy.
+    own scales) and attention reads the dequantized view — under the
+    gather kernel as a history-granular select+multiply, under the flash
+    kernel with the scales folded into the score/probability vectors (or
+    applied in-tile on TPU) so f32 history is never materialized.  Both
+    attend the EXACT current token (storage is quantized, the in-flight
+    value costs nothing to keep f32) — only stored history pays the
+    8-bit grid.
     """
     b, d = x.shape
-    s = k_l.shape[1]
     hd = d // num_heads
 
     h = _layer_norm(x, p["ln1"])
@@ -298,25 +304,12 @@ def _block_decode(p, x, k_l, v_l, pos, *, num_heads: int, k_s=None, v_s=None):
         v_l = v_l.at[rows, pos].set(vq)
         k_s = k_s.at[rows, pos].set(ks_t)
         v_s = v_s.at[rows, pos].set(vs_t)
-        # attend with the EXACT current token (storage is quantized, the
-        # in-flight value costs nothing to keep f32) — only the stored
-        # history pays the 8-bit grid.  A select, not a scatter: XLA
-        # fuses the select+dequant into the consuming einsum, where a
-        # scatter would materialize the full f32 [B,S,h,hd] view.
-        own = (jnp.arange(s)[None, :] == pos[:, None])[..., None, None]
-        k_seq = jnp.where(own, k_t[:, None], _dq_kv(k_l, k_s))
-        v_seq = jnp.where(own, v_t[:, None], _dq_kv(v_l, v_s))
     else:
         k_l = k_l.at[rows, pos].set(k_t.astype(k_l.dtype))
         v_l = v_l.at[rows, pos].set(v_t.astype(v_l.dtype))
-        k_seq, v_seq = k_l, v_l
-    scores = jnp.einsum("bhd,bshd->bhs", q, k_seq) / jnp.sqrt(
-        jnp.asarray(hd, jnp.float32)
-    )  # f32 via the f32 scale, matching block_apply
-    visible = jnp.arange(s)[None, :] <= pos[:, None]  # [b, s]
-    scores = jnp.where(visible[:, None, :], scores, -1e30)
-    attn = jax.nn.softmax(scores, axis=-1).astype(v_seq.dtype)
-    ctx = jnp.einsum("bhs,bshd->bhd", attn, v_seq).reshape(b, d).astype(x.dtype)
+    ctx = _fd.decode_attention_dense(
+        q, k_l, v_l, k_s, v_s, k_t, v_t, pos, kernel=kernel
+    ).reshape(b, d).astype(x.dtype)
     x = x + _mm(ctx, p["proj"])
 
     h = _layer_norm(x, p["ln2"])
@@ -324,7 +317,8 @@ def _block_decode(p, x, k_l, v_l, pos, *, num_heads: int, k_s=None, v_s=None):
     return x, k_l, v_l, k_s, v_s
 
 
-def forward_decode(params, token, cache, pos, *, num_heads: int):
+def forward_decode(params, token, cache, pos, *, num_heads: int,
+                   kernel: str = "gather"):
     """Single-token decode step: next-token logits from the KV cache.
 
     ``token``: [B] int32 — each slot's current token; ``pos``: [B] int32 —
@@ -333,6 +327,12 @@ def forward_decode(params, token, cache, pos, *, num_heads: int):
     ``[B, L, S, h, hd]`` (:mod:`serve.kv_cache` layout), plus
     ``{"k_scale", "v_scale"}`` ([B, L, S, h] f32) under the int8 layout —
     writes quantize, reads dequantize fused into attention.
+
+    ``kernel``: how attention consumes the cache (``ops.flash_decode``):
+    ``"gather"`` is the legacy dense read; ``"flash"`` the paged
+    flash-decode kernel (Pallas on TPU — in-tile dequant, f32 history
+    never in HBM; the fused-XLA twin elsewhere, bitwise identical to
+    gather for f32 caches).
 
     Returns ``(logits [B, vocab], new_cache)`` where ``new_cache`` has the
     token's K/V written at ``pos`` in every layer.  O(S·d) per token per
@@ -349,7 +349,8 @@ def forward_decode(params, token, cache, pos, *, num_heads: int):
     def body(carry, xs):
         p, k_l, v_l, k_s, v_s = xs
         carry, k_l, v_l, k_s, v_s = _block_decode(
-            p, carry, k_l, v_l, pos, num_heads=num_heads, k_s=k_s, v_s=v_s
+            p, carry, k_l, v_l, pos, num_heads=num_heads, k_s=k_s, v_s=v_s,
+            kernel=kernel,
         )
         return carry, (k_l, v_l, k_s, v_s)
 
@@ -373,7 +374,7 @@ def forward_decode(params, token, cache, pos, *, num_heads: int):
 
 def _block_decode_paged(
     p, x, k_l, v_l, pos, block_tables, *, num_heads: int, page_size: int,
-    k_s=None, v_s=None,
+    k_s=None, v_s=None, kernel: str = "gather",
 ):
     """One block's single-token decode against a PAGED cache layer.
 
@@ -383,17 +384,18 @@ def _block_decode_paged(
     ``(table[j // page_size], j % page_size)``).  Same write-then-attend
     order as :func:`_block_decode`: the new token's K/V scatter to
     ``(table[pos // ps], pos % ps)``, then attention runs over the slot's
-    gathered pages with positions ``<= pos`` visible.  Released slots
-    point every table entry at the scratch page and sit at pos 0, so their
-    writes land in the dustbin and never touch a live page.
+    pages with positions ``<= pos`` visible — via the block-table gather
+    (``kernel="gather"``) or the paged flash-decode kernel
+    (``kernel="flash"``: pages stream directly, int8 dequant in-tile /
+    scale-folded; :mod:`ops.flash_decode`).  Released slots point every
+    table entry at the scratch page and sit at pos 0, so their writes
+    land in the dustbin and never touch a live page.
 
     ``k_s``/``v_s`` ([pages, page_size, h] f32, int8 pool only): writes
-    quantize per head, the block-table gather pulls values AND scales,
-    and the dequant multiply fuses into the attention einsums.
+    quantize per head; attention reads the dequantized view with the
+    exact current token overlaid (see :func:`_block_decode`).
     """
     b, d = x.shape
-    nb = block_tables.shape[1]
-    s = nb * page_size
     hd = d // num_heads
 
     h = _layer_norm(x, p["ln1"])
@@ -412,38 +414,13 @@ def _block_decode_paged(
         v_l = v_l.at[page, off].set(vq)
         k_s = k_s.at[page, off].set(ks_t)
         v_s = v_s.at[page, off].set(vs_t)
-        # exact current token in the attended view, via a fusable select
-        # (see _block_decode); pos < nb * page_size always
-        own = (jnp.arange(s)[None, :] == pos[:, None])[..., None, None]
-        k_seq = jnp.where(
-            own,
-            k_t[:, None],
-            _dq_kv(k_l[block_tables], k_s[block_tables]).reshape(
-                b, s, num_heads, hd
-            ),
-        )
-        v_seq = jnp.where(
-            own,
-            v_t[:, None],
-            _dq_kv(v_l[block_tables], v_s[block_tables]).reshape(
-                b, s, num_heads, hd
-            ),
-        )
     else:
         k_l = k_l.at[page, off].set(k_t.astype(k_l.dtype))
         v_l = v_l.at[page, off].set(v_t.astype(v_l.dtype))
-        # block-table gather: [b, nb, ps, h, hd] -> the logical [s] view
-        k_seq = k_l[block_tables].reshape(b, s, num_heads, hd)
-        v_seq = v_l[block_tables].reshape(b, s, num_heads, hd)
-    scores = jnp.einsum("bhd,bshd->bhs", q, k_seq) / jnp.sqrt(
-        jnp.asarray(hd, jnp.float32)
-    )
-    visible = jnp.arange(s)[None, :] <= pos[:, None]  # [b, s]
-    scores = jnp.where(visible[:, None, :], scores, -1e30)
-    attn = jax.nn.softmax(scores, axis=-1).astype(v_seq.dtype)
-    ctx = jnp.einsum("bhs,bshd->bhd", attn, v_seq).reshape(b, d).astype(
-        x.dtype
-    )
+    ctx = _fd.decode_attention_paged(
+        q, k_l, v_l, k_s, v_s, k_t, v_t, pos, block_tables,
+        page_size=page_size, kernel=kernel,
+    ).reshape(b, d).astype(x.dtype)
     x = x + _mm(ctx, p["proj"])
 
     h = _layer_norm(x, p["ln2"])
@@ -453,7 +430,7 @@ def _block_decode_paged(
 
 def forward_decode_paged(
     params, token, cache, pos, block_tables, *, num_heads: int,
-    page_size: int,
+    page_size: int, kernel: str = "gather",
 ):
     """Single-token decode step over the PAGED cache layout.
 
@@ -467,9 +444,11 @@ def forward_decode_paged(
     padded with masked positions up to ``nb * page_size``.
 
     Int8 pool (``{"k_scale", "v_scale"}`` present, [pages, L, page_size,
-    h] f32): same program with quantize-on-write and a gather+dequant
-    fused into attention — the math matches the f32 paged path up to the
-    8-bit grid (``bench.py --quant`` reports the agreement rate and MAE).
+    h] f32): same program with quantize-on-write and the dequant read
+    fused into attention — at history granularity under ``kernel=
+    "gather"``, in-tile / scale-folded under ``kernel="flash"`` (see
+    :func:`forward_decode`) — the math matches the f32 paged path up to
+    the 8-bit grid (``bench.py --quant`` reports agreement rate and MAE).
     """
     x = params["embed"][token] + params["pos"][pos]  # [B, d]
     quantized = quantized_cache(cache)
@@ -479,6 +458,7 @@ def forward_decode_paged(
         carry, k_l, v_l, k_s, v_s = _block_decode_paged(
             p, carry, k_l, v_l, pos, block_tables,
             num_heads=num_heads, page_size=page_size, k_s=k_s, v_s=v_s,
+            kernel=kernel,
         )
         return carry, (k_l, v_l, k_s, v_s)
 
@@ -502,7 +482,7 @@ def forward_decode_paged(
 
 def forward_prefill_chunk(
     params, tokens, cache, block_table, offset, *, num_heads: int,
-    page_size: int,
+    page_size: int, kernel: str = "gather",
 ):
     """One CHUNK of a prompt prefilled against the paged cache.
 
@@ -562,33 +542,20 @@ def forward_prefill_chunk(
             v_l = v_l.at[pages, offs].set(vq)
             k_s = k_s.at[pages, offs].set(ks_c)
             v_s = v_s.at[pages, offs].set(vs_c)
-            # Prefill attends over the cache-roundtripped values for the
-            # own chunk TOO (no exact-self overlay here, unlike decode):
-            # per-token quantization is chunk-ALIGNMENT-invariant, so a
-            # prefix-cache hit (which shifts the chunk offset by the
-            # shared length) produces bit-identical logits to a cold
-            # run — an exact-own-chunk window would make the numbers
-            # depend on where the chunk boundaries fell.
-            k_seq = _dq_kv(k_l[block_table], k_s[block_table]).reshape(
-                s, num_heads, hd
-            )
-            v_seq = _dq_kv(v_l[block_table], v_s[block_table]).reshape(
-                s, num_heads, hd
-            )
         else:
             k_l = k_l.at[pages, offs].set(k_c.astype(k_l.dtype))
             v_l = v_l.at[pages, offs].set(v_c.astype(v_l.dtype))
-            k_seq = k_l[block_table].reshape(s, num_heads, hd)
-            v_seq = v_l[block_table].reshape(s, num_heads, hd)
-        scores = jnp.einsum("chd,shd->chs", q, k_seq) / jnp.sqrt(
-            jnp.asarray(hd, jnp.float32)
-        )
-        visible = jnp.arange(s)[None, :] <= posns[:, None]  # [C, s]
-        scores = jnp.where(visible[:, None, :], scores, -1e30)
-        attn = jax.nn.softmax(scores, axis=-1).astype(v_seq.dtype)
-        ctx = jnp.einsum("chs,shd->chd", attn, v_seq).reshape(C, d).astype(
-            carry.dtype
-        )
+        # Prefill attends over the cache-roundtripped values for the own
+        # chunk TOO (no exact-self overlay on int8 pools, unlike decode):
+        # per-token quantization is chunk-ALIGNMENT-invariant, so a
+        # prefix-cache hit (which shifts the chunk offset by the shared
+        # length) produces bit-identical logits to a cold run — an
+        # exact-own-chunk window would make the numbers depend on where
+        # the chunk boundaries fell.  Both kernels preserve this.
+        ctx = _fd.chunk_attention(
+            q, k_l, v_l, k_s, v_s, block_table, posns,
+            page_size=page_size, kernel=kernel,
+        ).reshape(C, d).astype(carry.dtype)
         out = carry + _mm(ctx, p["proj"])
         h = _layer_norm(out, p["ln2"])
         out = out + _mm(
@@ -615,7 +582,8 @@ def forward_prefill_chunk(
 
 
 def forward_verify(
-    params, tokens, cache, pos, draft_len, *, num_heads: int
+    params, tokens, cache, pos, draft_len, *, num_heads: int,
+    kernel: str = "gather",
 ):
     """Batched K+1-token verification step against the DENSE cache — the
     verifier half of speculative decoding (``spec/``).
@@ -680,15 +648,9 @@ def forward_verify(
         v_c = v_c.reshape(b, K1, num_heads, hd)
         k_l = k_l.at[rows, wpos].set(k_c.astype(k_l.dtype), mode="drop")
         v_l = v_l.at[rows, wpos].set(v_c.astype(v_l.dtype), mode="drop")
-        scores = jnp.einsum("bqhd,bshd->bqhs", q, k_l) / jnp.sqrt(
-            jnp.asarray(hd, jnp.float32)
-        )
-        visible = jnp.arange(S)[None, None, :] <= posmat[:, :, None]
-        scores = jnp.where(visible[:, :, None, :], scores, -1e30)
-        attn = jax.nn.softmax(scores, axis=-1).astype(v_l.dtype)
-        ctx = jnp.einsum("bqhs,bshd->bqhd", attn, v_l).reshape(
-            b, K1, d
-        ).astype(carry.dtype)
+        ctx = _fd.verify_attention_dense(
+            q, k_l, v_l, posmat, kernel=kernel
+        ).reshape(b, K1, d).astype(carry.dtype)
         out = carry + _mm(ctx, p["proj"])
         h = _layer_norm(out, p["ln2"])
         out = out + _mm(
@@ -711,7 +673,7 @@ def forward_verify(
 
 def forward_verify_paged(
     params, tokens, cache, pos, draft_len, block_tables, *,
-    num_heads: int, page_size: int,
+    num_heads: int, page_size: int, kernel: str = "gather",
 ):
     """Batched K+1-token verification step over the PAGED cache layout.
 
@@ -764,17 +726,10 @@ def forward_verify_paged(
         v_c = v_c.reshape(b, K1, num_heads, hd)
         k_l = k_l.at[pages, offs].set(k_c.astype(k_l.dtype))
         v_l = v_l.at[pages, offs].set(v_c.astype(v_l.dtype))
-        k_seq = k_l[block_tables].reshape(b, s, num_heads, hd)
-        v_seq = v_l[block_tables].reshape(b, s, num_heads, hd)
-        scores = jnp.einsum("bqhd,bshd->bqhs", q, k_seq) / jnp.sqrt(
-            jnp.asarray(hd, jnp.float32)
-        )
-        visible = jnp.arange(s)[None, None, :] <= posmat[:, :, None]
-        scores = jnp.where(visible[:, :, None, :], scores, -1e30)
-        attn = jax.nn.softmax(scores, axis=-1).astype(v_seq.dtype)
-        ctx = jnp.einsum("bqhs,bshd->bqhd", attn, v_seq).reshape(
-            b, K1, d
-        ).astype(carry.dtype)
+        ctx = _fd.verify_attention_paged(
+            q, k_l, v_l, block_tables, posmat,
+            page_size=page_size, kernel=kernel,
+        ).reshape(b, K1, d).astype(carry.dtype)
         out = carry + _mm(ctx, p["proj"])
         h = _layer_norm(out, p["ln2"])
         out = out + _mm(

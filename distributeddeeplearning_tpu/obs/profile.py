@@ -309,20 +309,29 @@ def decode_phase_breakdown(
 ) -> Dict[str, Any]:
     """Measured per-phase decode cost of a paged serving engine.
 
-    Three phases, each timed as its own jitted program over the engine's
+    Four phases, each timed as its own jitted program over the engine's
     live cache and block tables (so the measured traffic is the decode
     step's real traffic):
 
     - ``page_gather``: gathering every slot's K/V history pages through
-      the block tables — the cache-bandwidth phase;
-    - ``scale_dequant``: the int8 path's extra work — gather plus the
-      per-(position, head) scale multiply materializing f32 history
+      the block tables — the cache-bandwidth sub-probe;
+    - ``scale_dequant``: the int8 gather path's extra work — gather plus
+      the per-(position, head) scale multiply materializing f32 history
       (measured as the increment over ``page_gather``; 0 on f32 engines);
-    - ``attention_mlp_other``: everything else in the step (einsums, MLP,
-      sampling, dispatch) — the full decode step minus the above.
+    - ``attention_kernel``: the WHOLE per-step attention over the full
+      cached history, all layers, through the engine's configured
+      ``decode_kernel`` (``ops.flash_decode``) — the phase OBS_r11 could
+      not see inside ``attention_mlp_other``, and the one a fused-kernel
+      regression (or win) lands in;
+    - ``mlp_other``: the decode step minus ``attention_kernel`` — qkv/
+      proj/FF/head matmuls, sampling, dispatch.
+
+    ``page_gather``/``scale_dequant`` are sub-probes OF the attention
+    phase (the kernel's own cache reads), so the four phases are not
+    additive; ``attention_kernel + mlp_other`` is the whole step.
 
     ``decode_step_ms`` is the real step (``engine.decode``), measured the
-    same way the SERVE/QUANT artifacts measure it, so shares sum to 1.
+    same way the SERVE/QUANT artifacts measure it.
 
     With a ``spec_decoder`` (``spec.SpeculativeDecoder`` over this same
     engine) two more phases are measured from real spec steps over the
@@ -341,6 +350,7 @@ def decode_phase_breakdown(
     import jax.numpy as jnp
     import numpy as np
 
+    from distributeddeeplearning_tpu.ops import flash_decode as fd
     from distributeddeeplearning_tpu.quant.qtensor import dequantize_kv
 
     cache = engine.cache
@@ -373,6 +383,52 @@ def decode_phase_breakdown(
     else:
         t_dequant = 0.0
 
+    # the whole attention phase: per-layer decode attention over the
+    # LIVE cache at full-history positions through the engine's real
+    # kernel path (fixed pseudo-random queries — the traffic, masking
+    # and kernel dispatch are the step's own; only the q values differ)
+    num_heads = engine.num_heads
+    hd = cache["k"].shape[-1]
+    L = cache["k"].shape[1]
+    b = engine.batch_slots
+    kernel = getattr(engine, "decode_kernel", "gather")
+    page_size = engine.page_size
+    key = jax.random.key(7)
+    q_all = jax.random.normal(key, (L, b, num_heads, hd), jnp.float32)
+    kt = jax.random.normal(
+        jax.random.fold_in(key, 1), (b, num_heads, hd), jnp.float32
+    )
+    vt = jax.random.normal(
+        jax.random.fold_in(key, 2), (b, num_heads, hd), jnp.float32
+    )
+    attn_pos = jnp.full((b,), engine.max_seq - 2, jnp.int32)
+
+    def _attn_stack(k, v, ks, vs, tbl):
+        def body(carry, xs):
+            q, k_l, v_l, k_s, v_s = xs
+            ctx = fd.decode_attention_paged(
+                q, k_l, v_l, k_s, v_s, kt, vt, attn_pos, tbl,
+                page_size=page_size, kernel=kernel,
+            )
+            return carry, ctx
+
+        xs = (
+            q_all,
+            jnp.moveaxis(k, 1, 0),
+            jnp.moveaxis(v, 1, 0),
+            jnp.moveaxis(ks, 1, 0) if ks is not None else None,
+            jnp.moveaxis(vs, 1, 0) if vs is not None else None,
+        )
+        _, ctxs = jax.lax.scan(body, 0, xs)
+        return ctxs
+
+    t_attention = _time_jitted(
+        jax.jit(_attn_stack),
+        (cache["k"], cache["v"], cache.get("k_scale"),
+         cache.get("v_scale"), tables),
+        iters=iters, warmup=warmup,
+    )
+
     # the real decode step, same methodology as the serve benchmarks:
     # dispatch + compute + the sampled-token readback.  Positions sit at
     # the END of the window so attention spans the full cached history —
@@ -388,17 +444,19 @@ def decode_phase_breakdown(
         engine.decode(tokens, pos)
     t_decode = (time.perf_counter() - t0) / iters
 
-    residual = max(t_decode - t_gather - t_dequant, 0.0)
+    residual = max(t_decode - t_attention, 0.0)
     phases_ms = {
         "page_gather": round(t_gather * 1e3, 3),
         "scale_dequant": round(t_dequant * 1e3, 3),
-        "attention_mlp_other": round(residual * 1e3, 3),
+        "attention_kernel": round(t_attention * 1e3, 3),
+        "mlp_other": round(residual * 1e3, 3),
     }
     total = max(t_decode, 1e-12)
     out = {
         "decode_step_ms": round(t_decode * 1e3, 3),
         "kv_dtype": engine.kv_dtype,
         "weights_dtype": engine.weights_dtype,
+        "decode_kernel": kernel,
         "phases_ms": phases_ms,
         "phase_share_of_step": {
             name: round(ms / 1e3 / total, 4) for name, ms in phases_ms.items()
@@ -458,17 +516,27 @@ def attribute_regression(
     candidate, reported with its absolute delta and its share of the
     candidate's step time — the "where did the 82 ms go" answer
     QUANT_r10 could not give.
+
+    Deltas are computed over the phases BOTH breakdowns measured: a
+    phase present on only one side (e.g. comparing a pre-split
+    ``attention_mlp_other`` baseline against the ``attention_kernel`` /
+    ``mlp_other`` split) has no meaningful delta — zero-defaulting it
+    would report the candidate phase's WHOLE time as growth.  One-sided
+    phases are surfaced in ``unmatched_phases`` instead of silently
+    skewing the attribution.
     """
+    common = [n for n in candidate["phases_ms"] if n in baseline["phases_ms"]]
+    unmatched = sorted(
+        set(candidate["phases_ms"]) ^ set(baseline["phases_ms"])
+    )
     deltas = {
         name: round(
-            candidate["phases_ms"][name] - baseline["phases_ms"].get(name, 0.0),
-            3,
+            candidate["phases_ms"][name] - baseline["phases_ms"][name], 3
         )
-        for name in candidate["phases_ms"]
+        for name in common
     }
-    hottest = max(deltas, key=lambda k: deltas[k])
     total = max(candidate["decode_step_ms"], 1e-9)
-    return {
+    out = {
         "decode_step_ms": {
             "baseline": baseline["decode_step_ms"],
             "candidate": candidate["decode_step_ms"],
@@ -477,9 +545,18 @@ def attribute_regression(
             candidate["decode_step_ms"] - baseline["decode_step_ms"], 3
         ),
         "phase_delta_ms": deltas,
-        "hottest_phase": hottest,
-        "hottest_phase_delta_ms": deltas[hottest],
-        "hottest_phase_share_of_step_time": round(
-            candidate["phases_ms"][hottest] / total, 4
-        ),
     }
+    if unmatched:
+        out["unmatched_phases"] = unmatched
+    if deltas:
+        hottest = max(deltas, key=lambda k: deltas[k])
+        out["hottest_phase"] = hottest
+        out["hottest_phase_delta_ms"] = deltas[hottest]
+        out["hottest_phase_share_of_step_time"] = round(
+            candidate["phases_ms"][hottest] / total, 4
+        )
+    else:
+        out["hottest_phase"] = "decode_step"
+        out["hottest_phase_delta_ms"] = out["regression_ms"]
+        out["hottest_phase_share_of_step_time"] = 1.0
+    return out

@@ -30,6 +30,7 @@ all-float.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Optional
 
 import jax
@@ -429,6 +430,12 @@ def _auto_block(s: int, cap: int = 1024) -> int:
 # is fine (the grid is a single tile), so the effective floor is min(S, 128).
 AUTO_BLOCK_FLOOR = 128
 
+#: Shape classes (s, block_q, block_k) the dense-fallback warning already
+#: fired for — warn ONCE per process per shape: small-dim serve loops and
+#: tests hit the fallback every call, and a per-call warning floods stderr
+#: without adding information.
+_WARNED_FALLBACKS: set = set()
+
 
 def _dense_attention(q, k, v, mask, *, dtype, causal):
     """Reference dense attention with the kernel's exact semantics (f32
@@ -482,16 +489,20 @@ def flash_attention(
     if (auto_q and block_q < floor) or (auto_k and block_k < floor):
         # Low power-of-two divisibility (1032 → block 8, odd S → 1): the
         # (S/b)² grid compiles and runs pathologically.  Degrading LOUDLY
-        # to dense beats both silent degradation and the old hard error.
-        import warnings
-
-        warnings.warn(
-            f"flash_attention: seq len {s} auto-selects block "
-            f"({block_q}, {block_k}) below the {AUTO_BLOCK_FLOOR} floor — "
-            "falling back to dense attention (pad the sequence or pass "
-            "explicit block_q/block_k to force the kernel)",
-            stacklevel=2,
-        )
+        # to dense beats both silent degradation and the old hard error —
+        # but loudly ONCE per shape class: a serve loop hits this every
+        # decode/prefill call with the same shapes.
+        shape_class = (s, block_q, block_k)
+        if shape_class not in _WARNED_FALLBACKS:
+            _WARNED_FALLBACKS.add(shape_class)
+            warnings.warn(
+                f"flash_attention: seq len {s} auto-selects block "
+                f"({block_q}, {block_k}) below the {AUTO_BLOCK_FLOOR} "
+                "floor — falling back to dense attention (pad the "
+                "sequence or pass explicit block_q/block_k to force the "
+                "kernel; warned once per shape)",
+                stacklevel=2,
+            )
         return _dense_attention(q, k, v, mask, dtype=dtype, causal=causal)
     if s % block_q or s % block_k:
         raise ValueError(

@@ -1,0 +1,623 @@
+"""Paged flash-decode: block-table-aware attention over the KV cache.
+
+The serving-side sibling of :mod:`ops.flash_attention`, built for ROADMAP
+Open item 2(a): QUANT_r10 showed int8 KV pages win 3.76x capacity but LOSE
+decode speed, and OBS_r11 machine-attributed the regression to the
+attention consuming a block-table-gathered, fully *dequantized* f32
+history.  This module makes the attention read quantized bytes all the way
+into the tile:
+
+- **Pallas kernel** (:func:`_pallas_attention`): grid ``(slots, heads,
+  history_blocks)`` with the history dimension sequential — an
+  online-softmax split-K over the slot's pages.  Block tables ride as
+  scalar prefetch (``pltpu.PrefetchScalarGridSpec``) so each K/V tile's
+  ``BlockSpec`` index_map resolves ``logical page j -> physical page
+  tables[b, j]`` and the pages stream HBM→VMEM **directly** — the gathered
+  ``[b, s, h, hd]`` history never exists as an array.  Int8 pools
+  dequantize *inside the tile*: ``kf = k_int8 · scale[pos, head]`` at
+  ``[page_size, hd]`` granularity, so f32 history never exists in HBM at
+  all.  Runs in interpret mode off-TPU (same pattern as
+  ``ops.flash_attention``), which is how tier-1 pins its math on CPU.
+
+- **Fused-XLA twin** (the ``_xla_*`` paths): the same read discipline
+  expressed in XLA for backends where interpret-mode Pallas would be an
+  emulation, not a kernel.  For f32 pools it is op-for-op the legacy
+  gather path (bitwise identical — the decode==full-forward pin extends
+  through it for free).  For int8 pools the per-(position, head) scales
+  FOLD into the ``[b, h, s]`` score/probability vectors instead of
+  scaling the ``[b, s, h, hd]`` history: the only history-sized f32 value
+  left is the bare int8→f32 widening feeding the matmul, and the scale
+  multiply / own-token select that made the old path slow (and that the
+  dtype audit now bans at history granularity) are gone.  Measured on the
+  bench geometry this turns the int8 decode step from +8% slower than f32
+  into faster than f32 — the both-axes win QUANT_r15 gates on.
+
+- **Legacy gather** (the ``_gather_*`` paths): the pre-kernel code moved
+  here verbatim from ``models.pipelined_transformer`` — still the
+  reference every flash variant is pinned against
+  (``tests/test_flash_decode.py``), and still selectable end-to-end via
+  ``--decode-kernel gather``.
+
+Kernel selection (:func:`resolve_kernel`): ``"auto"`` → ``"flash"``;
+``"flash"`` runs the Pallas kernel on TPU and the fused-XLA twin
+elsewhere (or when the shapes don't tile); ``"gather"`` forces the legacy
+path.  ``"pallas"``/``"xla"`` pin one flash implementation for tests.
+
+Exact-current-token semantics are preserved: the int8 *decode* paths
+overlay the in-flight token's exact f32 K/V (storage is quantized, the
+attended view is exact — ``_block_decode``'s contract), folded at score /
+context granularity here; chunked prefill deliberately does NOT overlay
+(per-token quantization keeps prefill chunk-alignment-invariant, the
+prefix-cache bit-identity property).  Speculative verify is f32-only
+upstream, so its flash path is the bitwise-identical f32 form.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pallas extras are absent on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_BIG = -1e30  # finite mask fill, matching the gather reference
+
+#: Pallas history blocks below this run a pathological grid on TPU; the
+#: flash dispatch falls back to the fused-XLA twin instead (page_size
+#: already bounds the tile, so this only bites hand-picked tiny pages).
+PALLAS_BLOCK_FLOOR = 8
+
+KERNELS = ("auto", "flash", "gather", "pallas", "xla")
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def resolve_kernel(kernel: str) -> str:
+    """Normalize a ``--decode-kernel`` choice to ``"flash"``/``"gather"``
+    (the two *semantic* paths; ``"pallas"``/``"xla"`` pin a flash
+    implementation and resolve to themselves for tests)."""
+    if kernel not in KERNELS:
+        raise ValueError(
+            f"unknown decode kernel {kernel!r} (choices: {KERNELS})"
+        )
+    return "flash" if kernel == "auto" else kernel
+
+
+def _flash_impl(kernel: str) -> str:
+    """Which flash implementation a resolved kernel runs HERE: the Pallas
+    kernel on TPU, the fused-XLA twin elsewhere; explicit ``pallas``/
+    ``xla`` force one (tests; the Pallas path interprets off-TPU)."""
+    if kernel in ("pallas", "xla"):
+        return kernel
+    return "pallas" if not _use_interpret() else "xla"
+
+
+def _sqrt_dim(hd: int):
+    # the score DIVISOR: the gather reference divides by jnp.sqrt(hd);
+    # keep the exact same op so the f32 twin stays bitwise identical
+    return jnp.sqrt(jnp.asarray(hd, jnp.float32))
+
+
+# --------------------------------------------------------------------------
+# Pallas kernel: online-softmax split-K over block-table pages
+# --------------------------------------------------------------------------
+
+
+def _kernel(tables_ref, posmat_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+            ko_ref, vo_ref, o_ref, m_ref, l_ref, acc_ref, *,
+            block: int, hd: int, quantized: bool, overlay: bool):
+    """One (slot, head, history-block) grid step.
+
+    ``q_ref`` [1, nq, 1, hd]; ``k_ref``/``v_ref`` [1, block, 1, hd] — the
+    physical page the index_map resolved through the prefetched block
+    table; ``ks_ref``/``vs_ref`` [1, block, 1] per-(position, head)
+    scales (int8 pools); ``ko_ref``/``vo_ref`` [1, 1, hd] the slot's
+    exact in-flight token (decode overlay).  Scratch ``m``/``l``
+    [nq, 128] and ``acc`` [nq, hd] carry the online-softmax state across
+    the sequential history dimension.
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    posmat = posmat_ref[b]  # [nq] this slot's per-query positions (SMEM)
+
+    # whole-block skip past the newest visible position: blocks beyond
+    # max(posmat) contribute nothing (the split-K causal saving)
+    @pl.when(j * block <= jnp.max(posmat))
+    def _compute():
+        q = q_ref[0, :, 0, :]  # [nq, hd]
+        k = k_ref[0, :, 0, :]  # [block, hd] int8 | f32
+        v = v_ref[0, :, 0, :]
+        cols = j * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, 1), 0
+        )[:, 0]  # [block] logical positions of this tile
+        if quantized:
+            # in-tile dequant: one multiply per stored vector at
+            # [block, hd] granularity — f32 history never leaves VMEM
+            kf = k.astype(jnp.float32) * ks_ref[0, :, 0][:, None]
+            vf = v.astype(jnp.float32) * vs_ref[0, :, 0][:, None]
+        else:
+            kf = k.astype(jnp.float32)
+            vf = v.astype(jnp.float32)
+        if overlay:
+            # decode's exact-current-token contract: the attended view
+            # holds the in-flight f32 K/V at the slot's own position
+            own = (cols == posmat[0])[:, None]
+            kf = jnp.where(own, ko_ref[0, 0][None, :], kf)
+            vf = jnp.where(own, vo_ref[0, 0][None, :], vf)
+        s = jax.lax.dot_general(
+            q, kf, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) / _sqrt_dim(hd)  # [nq, block]
+        s = jnp.where(cols[None, :] <= posmat[:, None], s, NEG_BIG)
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_cur)
+        corr = jnp.exp(m_prev - m_cur)
+        l_ref[:, :1] = l_ref[:, :1] * corr + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, vf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:, :1] = m_cur
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, :, 0, :] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _pallas_attention(
+    q4: jax.Array,
+    k_l: jax.Array,
+    v_l: jax.Array,
+    k_s: Optional[jax.Array],
+    v_s: Optional[jax.Array],
+    tables: jax.Array,
+    posmat: jax.Array,
+    *,
+    block: int,
+    k_own: Optional[jax.Array] = None,
+    v_own: Optional[jax.Array] = None,
+) -> jax.Array:
+    """The kernel call: ``q4`` [b, nq, h, hd] against pool pages ``k_l``/
+    ``v_l`` [P, block, h, hd] addressed through ``tables`` [b, nb];
+    ``posmat`` [b, nq] per-query visibility.  Returns [b, nq, h, hd] f32.
+    """
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError("pallas TPU support unavailable in this jax build")
+    b, nq, h, hd = q4.shape
+    nb = tables.shape[1]
+    quantized = k_s is not None
+    overlay = k_own is not None
+    if overlay and nq != 1:
+        # the in-kernel own-position select reads posmat[0] — the
+        # single-token decode contract; a multi-query overlay would
+        # silently place every row's overlay at query 0's position
+        raise ValueError(
+            "own-token overlay supports single-query decode only "
+            f"(nq={nq})"
+        )
+    kern = functools.partial(
+        _kernel, block=block, hd=hd, quantized=quantized, overlay=overlay,
+    )
+    # unquantized/no-overlay variants still take the operand slots (one
+    # kernel signature); size-1 dummies keep the BlockSpecs trivial
+    dummy_s = jnp.zeros((1, 1, 1), jnp.float32)
+    dummy_o = jnp.zeros((1, 1, hd), jnp.float32)
+    page_spec = pl.BlockSpec(
+        (1, block, 1, hd), lambda bb, hh, j, tbl, pm: (tbl[bb, j], 0, hh, 0)
+    )
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, block, 1), lambda bb, hh, j, tbl, pm: (tbl[bb, j], 0, hh)
+        )
+    else:
+        scale_spec = pl.BlockSpec(
+            (1, 1, 1), lambda bb, hh, j, tbl, pm: (0, 0, 0)
+        )
+    if overlay:
+        own_spec = pl.BlockSpec(
+            (1, 1, hd), lambda bb, hh, j, tbl, pm: (bb, hh, 0)
+        )
+    else:
+        own_spec = pl.BlockSpec(
+            (1, 1, hd), lambda bb, hh, j, tbl, pm: (0, 0, 0)
+        )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # tables + posmat land in SMEM up front
+        grid=(b, h, nb),
+        in_specs=[
+            pl.BlockSpec(
+                (1, nq, 1, hd), lambda bb, hh, j, tbl, pm: (bb, 0, hh, 0)
+            ),
+            page_spec,
+            page_spec,
+            scale_spec,
+            scale_spec,
+            own_spec,
+            own_spec,
+        ],
+        out_specs=pl.BlockSpec(
+            (1, nq, 1, hd), lambda bb, hh, j, tbl, pm: (bb, 0, hh, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((nq, 128), jnp.float32),
+            pltpu.VMEM((nq, 128), jnp.float32),
+            pltpu.VMEM((nq, hd), jnp.float32),
+        ],
+    )
+    compiler_params = None
+    if not _use_interpret():
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nq, h, hd), jnp.float32),
+        compiler_params=compiler_params,
+        interpret=_use_interpret(),
+    )(
+        tables,
+        posmat,
+        q4,
+        k_l,
+        v_l,
+        k_s if quantized else dummy_s,
+        v_s if quantized else dummy_s,
+        k_own if overlay else dummy_o,
+        v_own if overlay else dummy_o,
+    )
+
+
+def _dense_block(s: int, cap: int = 128) -> int:
+    """Largest power-of-two-descending divisor of ``s`` up to ``cap`` —
+    the synthetic "page size" the dense layout tiles its [B, S] rows into
+    for the kernel (below :data:`PALLAS_BLOCK_FLOOR` the dispatch takes
+    the XLA twin instead of running a pathological grid)."""
+    b = min(cap, s)
+    while s % b:
+        b //= 2
+    return b
+
+
+def _dense_as_pages(k_l, v_l, k_s, v_s, block: int):
+    """View a dense [B, S, ...] cache layer as pool pages [B·S/block,
+    block, ...] plus the identity block tables — the reshape is
+    layout-preserving, so the kernel's paged addressing covers the dense
+    layout with zero data movement."""
+    b, s = k_l.shape[0], k_l.shape[1]
+    nb = s // block
+
+    def pages(leaf):
+        if leaf is None:
+            return None
+        return leaf.reshape((b * nb, block) + leaf.shape[2:])
+
+    tables = (
+        jnp.arange(b, dtype=jnp.int32)[:, None] * nb
+        + jnp.arange(nb, dtype=jnp.int32)[None]
+    )
+    return pages(k_l), pages(v_l), pages(k_s), pages(v_s), tables
+
+
+# --------------------------------------------------------------------------
+# Fused-XLA twin: scale-folded int8, verbatim-legacy f32
+# --------------------------------------------------------------------------
+
+
+def _xla_int8_scores(q3, kf, k_sc_t, hd):
+    """Folded scores: ``(q · k_int8f32) * scale`` — the per-position
+    scale multiplies the [b, h, s] score vector, never the [b, s, h, hd]
+    history."""
+    raw = jnp.einsum("bhd,bshd->bhs", q3, kf)
+    return raw * k_sc_t / _sqrt_dim(hd)
+
+
+def _xla_int8_decode(q3, kf, vf, k_sc_t, v_sc_t, k_t, v_t, pos, s, hd):
+    """Scale-folded int8 decode attention over converted values ``kf``/
+    ``vf`` [b, s, h, hd] (bare int8→f32 widening — the one history-sized
+    f32 the fused program keeps) with scales transposed to [b, h, s].
+    The exact-own-token contract folds too: the slot's own position gets
+    its score from the in-flight f32 K and its context contribution from
+    the in-flight f32 V — O(b·h) extras, not an O(b·s·h·hd) select."""
+    scores = _xla_int8_scores(q3, kf, k_sc_t, hd)
+    own_score = jnp.einsum("bhd,bhd->bh", q3, k_t) / _sqrt_dim(hd)
+    own = jnp.arange(s)[None, None, :] == pos[:, None, None]  # [b, 1, s]
+    scores = jnp.where(own, own_score[..., None], scores)
+    visible = jnp.arange(s)[None, :] <= pos[:, None]
+    scores = jnp.where(visible[:, None, :], scores, NEG_BIG)
+    attn = jax.nn.softmax(scores, axis=-1)
+    w = jnp.where(own, 0.0, attn * v_sc_t)
+    ctx = jnp.einsum("bhs,bshd->bhd", w, vf)
+    attn_own = jnp.take_along_axis(attn, pos[:, None, None], axis=-1)[..., 0]
+    return ctx + attn_own[..., None] * v_t
+
+
+# --------------------------------------------------------------------------
+# call-site entry points (one per consumer, shapes preserved exactly so
+# the f32 gather/XLA paths stay bitwise identical to the legacy inline
+# code they were moved from)
+# --------------------------------------------------------------------------
+
+
+def decode_attention_paged(
+    q3, k_l, v_l, k_s, v_s, k_t, v_t, pos, block_tables, *,
+    page_size: int, kernel: str = "gather",
+):
+    """Single-token decode attention over the paged pool.
+
+    ``q3``/``k_t``/``v_t``: [b, h, hd] (query + the exact in-flight
+    token); ``k_l``/``v_l``: [P, ps, h, hd] (this layer's pool slice,
+    already holding the current token's quantized write); ``k_s``/``v_s``:
+    [P, ps, h] f32 or None; ``pos``: [b]; returns ctx [b, h, hd].
+    """
+    b, num_heads, hd = q3.shape
+    nb = block_tables.shape[1]
+    s = nb * page_size
+    kernel = resolve_kernel(kernel)
+    if kernel in ("flash", "pallas", "xla"):
+        impl = _flash_impl(kernel)
+        if impl == "pallas" and page_size >= PALLAS_BLOCK_FLOOR:
+            out = _pallas_attention(
+                q3[:, None], k_l, v_l, k_s, v_s, block_tables,
+                pos[:, None], block=page_size,
+                k_own=k_t if k_s is not None else None,
+                v_own=v_t if k_s is not None else None,
+            )
+            return out[:, 0]
+        if k_s is None:
+            # f32 flash-XLA == the gather reference, op for op: there is
+            # no dequant to fuse, and keeping the identical program is
+            # what extends the decode==full-forward bitwise pin
+            return _gather_decode_paged(
+                q3, k_l, v_l, None, None, k_t, v_t, pos, block_tables,
+                page_size=page_size,
+            )
+        kf = k_l[block_tables].reshape(b, s, num_heads, hd).astype(
+            jnp.float32
+        )
+        vf = v_l[block_tables].reshape(b, s, num_heads, hd).astype(
+            jnp.float32
+        )
+        k_sc_t = jnp.swapaxes(k_s[block_tables].reshape(b, s, num_heads), 1, 2)
+        v_sc_t = jnp.swapaxes(v_s[block_tables].reshape(b, s, num_heads), 1, 2)
+        return _xla_int8_decode(
+            q3, kf, vf, k_sc_t, v_sc_t, k_t, v_t, pos, s, hd
+        )
+    return _gather_decode_paged(
+        q3, k_l, v_l, k_s, v_s, k_t, v_t, pos, block_tables,
+        page_size=page_size,
+    )
+
+
+def _gather_decode_paged(
+    q3, k_l, v_l, k_s, v_s, k_t, v_t, pos, block_tables, *, page_size: int
+):
+    """Legacy paged decode attention (verbatim from
+    ``_block_decode_paged``): block-table gather reconstructing the dense
+    [b, s, h, hd] view, dequant + own-token select at history granularity
+    on int8 pools — the reference the flash paths are pinned against."""
+    from distributeddeeplearning_tpu.quant.qtensor import dequantize_kv
+
+    b, num_heads, hd = q3.shape
+    nb = block_tables.shape[1]
+    s = nb * page_size
+    if k_s is not None:
+        own = (jnp.arange(s)[None, :] == pos[:, None])[..., None, None]
+        k_seq = jnp.where(
+            own,
+            k_t[:, None],
+            dequantize_kv(k_l[block_tables], k_s[block_tables]).reshape(
+                b, s, num_heads, hd
+            ),
+        )
+        v_seq = jnp.where(
+            own,
+            v_t[:, None],
+            dequantize_kv(v_l[block_tables], v_s[block_tables]).reshape(
+                b, s, num_heads, hd
+            ),
+        )
+    else:
+        k_seq = k_l[block_tables].reshape(b, s, num_heads, hd)
+        v_seq = v_l[block_tables].reshape(b, s, num_heads, hd)
+    scores = jnp.einsum("bhd,bshd->bhs", q3, k_seq) / _sqrt_dim(hd)
+    visible = jnp.arange(s)[None, :] <= pos[:, None]  # [b, s]
+    scores = jnp.where(visible[:, None, :], scores, NEG_BIG)
+    attn = jax.nn.softmax(scores, axis=-1).astype(v_seq.dtype)
+    return jnp.einsum("bhs,bshd->bhd", attn, v_seq)
+
+
+def decode_attention_dense(
+    q3, k_l, v_l, k_s, v_s, k_t, v_t, pos, *, kernel: str = "gather"
+):
+    """Single-token decode attention over the dense [b, S, h, hd] layout
+    (same contract as :func:`decode_attention_paged`, no indirection)."""
+    b, num_heads, hd = q3.shape
+    s = k_l.shape[1]
+    kernel = resolve_kernel(kernel)
+    if kernel in ("flash", "pallas", "xla"):
+        impl = _flash_impl(kernel)
+        block = _dense_block(s)
+        if impl == "pallas" and block >= PALLAS_BLOCK_FLOOR:
+            kp, vp, ksp, vsp, tables = _dense_as_pages(
+                k_l, v_l, k_s, v_s, block
+            )
+            out = _pallas_attention(
+                q3[:, None], kp, vp, ksp, vsp, tables, pos[:, None],
+                block=block,
+                k_own=k_t if k_s is not None else None,
+                v_own=v_t if k_s is not None else None,
+            )
+            return out[:, 0]
+        if k_s is None:
+            return _gather_decode_dense(
+                q3, k_l, v_l, None, None, k_t, v_t, pos
+            )
+        kf = k_l.astype(jnp.float32)
+        vf = v_l.astype(jnp.float32)
+        k_sc_t = jnp.swapaxes(k_s, 1, 2)
+        v_sc_t = jnp.swapaxes(v_s, 1, 2)
+        return _xla_int8_decode(
+            q3, kf, vf, k_sc_t, v_sc_t, k_t, v_t, pos, s, hd
+        )
+    return _gather_decode_dense(q3, k_l, v_l, k_s, v_s, k_t, v_t, pos)
+
+
+def _gather_decode_dense(q3, k_l, v_l, k_s, v_s, k_t, v_t, pos):
+    """Legacy dense decode attention (verbatim from ``_block_decode``)."""
+    from distributeddeeplearning_tpu.quant.qtensor import dequantize_kv
+
+    b, num_heads, hd = q3.shape
+    s = k_l.shape[1]
+    if k_s is not None:
+        own = (jnp.arange(s)[None, :] == pos[:, None])[..., None, None]
+        k_seq = jnp.where(own, k_t[:, None], dequantize_kv(k_l, k_s))
+        v_seq = jnp.where(own, v_t[:, None], dequantize_kv(v_l, v_s))
+    else:
+        k_seq, v_seq = k_l, v_l
+    scores = jnp.einsum("bhd,bshd->bhs", q3, k_seq) / _sqrt_dim(hd)
+    visible = jnp.arange(s)[None, :] <= pos[:, None]
+    scores = jnp.where(visible[:, None, :], scores, NEG_BIG)
+    attn = jax.nn.softmax(scores, axis=-1).astype(v_seq.dtype)
+    return jnp.einsum("bhs,bshd->bhd", attn, v_seq)
+
+
+def chunk_attention(
+    q_c, k_l, v_l, k_s, v_s, block_table, posns, *,
+    page_size: int, kernel: str = "gather",
+):
+    """Chunked-prefill history attention: ``q_c`` [C, h, hd] at logical
+    positions ``posns`` [C] against ONE sequence's pages (``block_table``
+    [nb]).  No own-token overlay on int8 pools — prefill attends the
+    cache-roundtripped values so quantized prefill stays chunk-alignment-
+    invariant (``forward_prefill_chunk``'s prefix-cache contract).
+    Returns ctx [C, h, hd]."""
+    C, num_heads, hd = q_c.shape
+    nb = block_table.shape[0]
+    s = nb * page_size
+    kernel = resolve_kernel(kernel)
+    if kernel in ("flash", "pallas", "xla"):
+        impl = _flash_impl(kernel)
+        if impl == "pallas" and page_size >= PALLAS_BLOCK_FLOOR:
+            out = _pallas_attention(
+                q_c[None], k_l, v_l, k_s, v_s, block_table[None],
+                posns[None], block=page_size,
+            )
+            return out[0]
+        if k_s is None:
+            return _gather_chunk(
+                q_c, k_l, v_l, None, None, block_table, posns,
+                page_size=page_size,
+            )
+        kf = k_l[block_table].reshape(s, num_heads, hd).astype(jnp.float32)
+        vf = v_l[block_table].reshape(s, num_heads, hd).astype(jnp.float32)
+        k_sc_t = jnp.swapaxes(k_s[block_table].reshape(s, num_heads), 0, 1)
+        v_sc_t = jnp.swapaxes(v_s[block_table].reshape(s, num_heads), 0, 1)
+        raw = jnp.einsum("chd,shd->chs", q_c, kf)
+        scores = raw * k_sc_t[None] / _sqrt_dim(hd)
+        visible = jnp.arange(s)[None, :] <= posns[:, None]  # [C, s]
+        scores = jnp.where(visible[:, None, :], scores, NEG_BIG)
+        attn = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("chs,shd->chd", attn * v_sc_t[None], vf)
+    return _gather_chunk(
+        q_c, k_l, v_l, k_s, v_s, block_table, posns, page_size=page_size
+    )
+
+
+def _gather_chunk(
+    q_c, k_l, v_l, k_s, v_s, block_table, posns, *, page_size: int
+):
+    """Legacy chunk attention (verbatim from ``forward_prefill_chunk``)."""
+    from distributeddeeplearning_tpu.quant.qtensor import dequantize_kv
+
+    C, num_heads, hd = q_c.shape
+    nb = block_table.shape[0]
+    s = nb * page_size
+    if k_s is not None:
+        k_seq = dequantize_kv(k_l[block_table], k_s[block_table]).reshape(
+            s, num_heads, hd
+        )
+        v_seq = dequantize_kv(v_l[block_table], v_s[block_table]).reshape(
+            s, num_heads, hd
+        )
+    else:
+        k_seq = k_l[block_table].reshape(s, num_heads, hd)
+        v_seq = v_l[block_table].reshape(s, num_heads, hd)
+    scores = jnp.einsum("chd,shd->chs", q_c, k_seq) / _sqrt_dim(hd)
+    visible = jnp.arange(s)[None, :] <= posns[:, None]  # [C, s]
+    scores = jnp.where(visible[:, None, :], scores, NEG_BIG)
+    attn = jax.nn.softmax(scores, axis=-1).astype(v_seq.dtype)
+    return jnp.einsum("chs,shd->chd", attn, v_seq)
+
+
+def verify_attention_paged(
+    q4, k_l, v_l, block_tables, posmat, *, page_size: int,
+    kernel: str = "gather",
+):
+    """Speculative-verify attention over the paged pool: ``q4``
+    [b, K1, h, hd] with per-query positions ``posmat`` [b, K1].  f32
+    pools only (the verify programs refuse int8 upstream), so the flash
+    XLA twin IS the gather reference — the spec bitwise pin rides
+    through unchanged; on TPU the Pallas kernel streams the same pages
+    the decode step does.  Returns ctx [b, K1, h, hd]."""
+    b, K1, num_heads, hd = q4.shape
+    kernel = resolve_kernel(kernel)
+    if kernel in ("flash", "pallas", "xla"):
+        if (
+            _flash_impl(kernel) == "pallas"
+            and page_size >= PALLAS_BLOCK_FLOOR
+        ):
+            return _pallas_attention(
+                q4, k_l, v_l, None, None, block_tables, posmat,
+                block=page_size,
+            )
+    nb = block_tables.shape[1]
+    s = nb * page_size
+    k_seq = k_l[block_tables].reshape(b, s, num_heads, hd)
+    v_seq = v_l[block_tables].reshape(b, s, num_heads, hd)
+    return _verify_dense_math(q4, k_seq, v_seq, posmat, hd)
+
+
+def verify_attention_dense(q4, k_l, v_l, posmat, *, kernel: str = "gather"):
+    """Speculative-verify attention over the dense cache ``k_l``/``v_l``
+    [b, S, h, hd] (f32 only, see :func:`verify_attention_paged`)."""
+    b, K1, num_heads, hd = q4.shape
+    s = k_l.shape[1]
+    kernel = resolve_kernel(kernel)
+    if kernel in ("flash", "pallas", "xla"):
+        block = _dense_block(s)
+        if _flash_impl(kernel) == "pallas" and block >= PALLAS_BLOCK_FLOOR:
+            kp, vp, _, _, tables = _dense_as_pages(
+                k_l, v_l, None, None, block
+            )
+            return _pallas_attention(
+                q4, kp, vp, None, None, tables, posmat, block=block
+            )
+    return _verify_dense_math(q4, k_l, v_l, posmat, hd)
+
+
+def _verify_dense_math(q4, k_seq, v_seq, posmat, hd):
+    """The verify einsums (verbatim from ``forward_verify``)."""
+    s = k_seq.shape[1]
+    scores = jnp.einsum("bqhd,bshd->bqhs", q4, k_seq) / _sqrt_dim(hd)
+    visible = jnp.arange(s)[None, None, :] <= posmat[:, :, None]
+    scores = jnp.where(visible[:, :, None, :], scores, NEG_BIG)
+    attn = jax.nn.softmax(scores, axis=-1).astype(v_seq.dtype)
+    return jnp.einsum("bqhs,bshd->bqhd", attn, v_seq)

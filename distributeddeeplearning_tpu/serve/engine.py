@@ -43,6 +43,7 @@ from distributeddeeplearning_tpu.models.pipelined_transformer import (
     forward_prefill,
     forward_prefill_chunk,
 )
+from distributeddeeplearning_tpu.ops.flash_decode import resolve_kernel
 from distributeddeeplearning_tpu.quant.calibrate import params_dtype
 from distributeddeeplearning_tpu.serve.kv_cache import (
     OutOfPages,
@@ -187,9 +188,15 @@ class InferenceEngine:
         cache_dtype=None,
         rng: Optional[jax.Array] = None,
         pad_id: int = 0,
+        decode_kernel: str = "auto",
     ):
         self.kv_layout = "dense"
         self.chunked_prefill = False
+        # "flash" = ops.flash_decode (Pallas kernel on TPU; fused-XLA
+        # twin elsewhere, where it is bitwise == gather for f32 caches);
+        # "gather" = the legacy dense cache read.  Resolved once so the
+        # compiled programs and the provenance the reports carry agree.
+        self.decode_kernel = resolve_kernel(decode_kernel)
         # distinct compiled prefill shapes (each new power-of-two bucket
         # is a mid-run jit recompile — ServeReport surfaces the count so
         # benchmark warmup can prove it drove them all to 0)
@@ -281,9 +288,12 @@ class InferenceEngine:
         def _insert_fn(cache, k, v, slot):
             return insert_sequence(cache, k, v, slot)
 
+        dec_kernel = self.decode_kernel
+
         def _decode_fn(params, cache, tokens, pos, step):
             logits, cache = forward_decode(
-                params, tokens, cache, pos, num_heads=num_heads
+                params, tokens, cache, pos, num_heads=num_heads,
+                kernel=dec_kernel,
             )
             # per-slot health verdict rides the step (one [slots] bool —
             # the NaN-quarantine signal, free next to the token readback)
@@ -503,10 +513,15 @@ class PagedInferenceEngine:
         pad_id: int = 0,
         prefix_cache: bool = True,
         capture_logits: bool = False,
+        decode_kernel: str = "auto",
     ):
         _, num_layers, head_dim = _validate_model_dims(
             params, num_heads=num_heads, max_seq=max_seq, top_k=top_k
         )
+        # see InferenceEngine: "flash" streams pages through
+        # ops.flash_decode (in-tile int8 dequant — the QUANT_r15 speed
+        # lever), "gather" is the legacy block-table-gather read
+        self.decode_kernel = resolve_kernel(decode_kernel)
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         if prefill_chunk < 1:
@@ -588,10 +603,13 @@ class PagedInferenceEngine:
                 top_k=top_k,
             )
 
+        dec_kernel = self.decode_kernel
+
         def _chunk_fn(params, cache, tokens, block_table, offset):
             return forward_prefill_chunk(
                 params, tokens, cache, block_table, offset,
                 num_heads=num_heads, page_size=page_size,
+                kernel=dec_kernel,
             )
 
         def _decode_fn(params, cache, tokens, pos, block_tables, step,
@@ -599,6 +617,7 @@ class PagedInferenceEngine:
             logits, cache = forward_decode_paged(
                 params, tokens, cache, pos, block_tables,
                 num_heads=num_heads, page_size=page_size,
+                kernel=dec_kernel,
             )
             # per-slot health verdict (NaN quarantine) — one [slots] bool
             finite = jnp.isfinite(logits).all(axis=-1)

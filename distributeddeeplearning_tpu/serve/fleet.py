@@ -113,6 +113,7 @@ class ReplicaSpec:
     prefix_cache: bool = True          # paged engines only
     prefill_attention: str = "flash"   # dense engines only
     cache_dtype: Optional[str] = None  # e.g. "int8"
+    decode_kernel: str = "auto"        # "auto" | "flash" | "gather"
     temperature: float = 0.0
     top_k: Optional[int] = None
     eos_id: Optional[int] = None
@@ -256,6 +257,7 @@ def _build_engine(spec: ReplicaSpec):
             top_k=spec.top_k,
             cache_dtype=cache_dtype,
             rng=jax.random.key(spec.seed),
+            decode_kernel=spec.decode_kernel,
         )
     engine, _ = data_parallel_engine(
         params,
@@ -267,6 +269,7 @@ def _build_engine(spec: ReplicaSpec):
         top_k=spec.top_k,
         cache_dtype=cache_dtype,
         rng=jax.random.key(spec.seed),
+        decode_kernel=spec.decode_kernel,
     )
     return engine
 

@@ -205,6 +205,10 @@ class ServeReport:
     # artifact is distinguishable from an f32 one without diffing configs
     kv_dtype: str = "float32"
     weights_dtype: str = "float32"
+    # which attention kernel consumed the cache ("flash" =
+    # ops.flash_decode, "gather" = the legacy dense read) — the QUANT
+    # artifacts compare the two, so the report must say which ran
+    decode_kernel: str = "gather"
     prefix_hit_rate: float = 0.0  # prompt tokens served from shared pages
     kv_bytes: int = 0  # KV pool bytes reserved
     # peak bytes committed to live sequences — equals kv_bytes under the
@@ -1219,6 +1223,7 @@ class ContinuousBatchingScheduler:
             kv_layout=getattr(engine, "kv_layout", "dense"),
             kv_dtype=getattr(engine, "kv_dtype", "float32"),
             weights_dtype=getattr(engine, "weights_dtype", "float32"),
+            decode_kernel=getattr(engine, "decode_kernel", "gather"),
             prefix_hit_rate=(
                 round(engine.prefix_hit_rate(), 4)
                 if hasattr(engine, "prefix_hit_rate")

@@ -124,6 +124,12 @@ class SpeculativeDecoder:
         num_heads = engine.num_heads
         paged = engine.kv_layout == "paged"
         self._paged = paged
+        # verify rides the SAME attention kernel the engine decodes with
+        # (ops.flash_decode): spec is f32-cache-only, where the flash
+        # XLA twin is bitwise identical to the gather reference, so the
+        # spec==sequential-decode pin is kernel-invariant off-TPU and
+        # the TPU kernel streams the same pages decode does
+        ver_kernel = getattr(engine, "decode_kernel", "gather")
 
         def _accept(logits, tokens, dlen):
             lg = logits.astype(jnp.float32)
@@ -152,6 +158,7 @@ class SpeculativeDecoder:
                 logits, cache = forward_verify_paged(
                     params, tokens, cache, pos, dlen, tables,
                     num_heads=num_heads, page_size=page_size,
+                    kernel=ver_kernel,
                 )
                 greedy, accepted, finite = _accept(logits, tokens, dlen)
                 return greedy, accepted, finite, cache
@@ -186,7 +193,7 @@ class SpeculativeDecoder:
             def _verify_fn(params, cache, tokens, pos, dlen):
                 logits, cache = forward_verify(
                     params, tokens, cache, pos, dlen,
-                    num_heads=num_heads,
+                    num_heads=num_heads, kernel=ver_kernel,
                 )
                 greedy, accepted, finite = _accept(logits, tokens, dlen)
                 return greedy, accepted, finite, cache

@@ -142,6 +142,31 @@ def f32_history_written() -> ProgramRecord:
     )
 
 
+def f32_history_intermediate() -> ProgramRecord:
+    """An int8-cache decode that dequantizes the whole history at
+    history granularity but keeps the f32 tensor INTERNAL (reduced away
+    before the outputs) — invisible to the output/write checks, caught
+    only by the strict intermediate audit the flash-decode records arm
+    (``int8_head_dim``).  This is the exact shape of the QUANT_r10
+    regression: the materialization was a fusable *intermediate*, and it
+    still cost +82 ms/step."""
+
+    def step(cache, tok):
+        # planted: scale multiply at [.., hist, heads, head_dim] — the
+        # history-granular dequant the fused kernel exists to delete
+        hist = cache["k"].astype(jnp.float32) * cache["k_scale"][..., None]
+        out = dict(cache)
+        out["k"] = cache["k"].at[0, 0, 0, 0, 0].set(tok)
+        return out, hist.sum()  # reduced: no history-shaped OUTPUT
+
+    return ProgramRecord(
+        "fixture.f32_history_intermediate",
+        jax.jit(step, donate_argnums=(0,)),
+        (_CACHE, _sds((), jnp.int8)),
+        donate_min=2, int8_history_len=64, int8_head_dim=8,
+    )
+
+
 def unsharded_leaf():
     """A cache tree that grew a leaf the sharding resolver doesn't know
     — returns ``(tree_abs, shardings)`` for ``check_tree_coverage``."""

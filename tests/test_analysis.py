@@ -277,6 +277,55 @@ class TestProgramAuditDetections:
         assert len(dtype) == 1, format_findings(findings)
         assert "RETURNS" in dtype[0].message
 
+    def test_f32_history_intermediate_caught(self, fixtures):
+        """The PR-12 extension: a history-granular dequant that never
+        reaches an output or a write (reduced away in-program) passes
+        the old checks but must fail the strict intermediate audit the
+        flash-decode records arm via ``int8_head_dim``."""
+        import dataclasses
+
+        from distributeddeeplearning_tpu.analysis.program_audit import (
+            check_program,
+        )
+
+        rec = fixtures.f32_history_intermediate()
+        findings = check_program(rec)
+        inter = [f for f in findings if "intermediate" in f.message]
+        assert inter, format_findings(findings)
+        assert "`mul`" in inter[0].message
+        # the SAME program with the strict audit unarmed passes clean —
+        # pins that the catch above is the new checker, nothing else
+        relaxed = dataclasses.replace(rec, int8_head_dim=None)
+        assert not check_program(relaxed), format_findings(
+            check_program(relaxed)
+        )
+
+    def test_gather_path_fails_strict_intermediate_audit(self):
+        """Non-vacuity for the clean-tree gate: arming the strict audit
+        on the LEGACY gather int8 decode programs (which the registry
+        deliberately registers relaxed) produces findings — so the flash
+        programs passing it means the fused read actually differs."""
+        import dataclasses
+
+        from distributeddeeplearning_tpu.analysis.program_audit import (
+            build_program_records,
+            check_program,
+        )
+
+        records = {r.name: r for r in build_program_records()}
+        for name in (
+            "serve.paged.int8_gather.decode",
+            "serve.dense.int8_gather.decode",
+        ):
+            rec = records[name]
+            assert rec.int8_head_dim is None, name  # registered relaxed
+            armed = dataclasses.replace(rec, int8_head_dim=8)
+            inter = [
+                f for f in check_program(armed)
+                if "intermediate" in f.message
+            ]
+            assert inter, f"{name}: gather path passed the strict audit"
+
     def test_f32_history_written_caught(self, fixtures):
         from distributeddeeplearning_tpu.analysis.program_audit import (
             check_program,
@@ -373,6 +422,11 @@ class TestCleanTree:
             "serve.paged.int8.decode", "spec.dense.verify",
             "spec.paged.verify", "spec.dense.rollback",
             "spec.dense.draft",
+            # PR 12: flash is the default kernel, and the legacy gather
+            # engines stay registered (still selectable end-to-end)
+            "serve.dense.int8_gather.decode",
+            "serve.paged.int8_gather.decode",
+            "serve.paged.int8_gather.prefill_chunk",
         ]
         for name in required:
             assert name in records, sorted(records)
@@ -382,6 +436,18 @@ class TestCleanTree:
         # the quantized variants run the dtype audit
         assert records["serve.dense.int8.decode"].int8_history_len
         assert records["serve.paged.int8.decode"].int8_history_len
+        # the default (flash) int8 programs arm the STRICT intermediate
+        # audit; the gather variants are relaxed by design
+        for name in (
+            "serve.dense.int8.decode", "serve.paged.int8.decode",
+            "serve.paged.int8.prefill_chunk",
+        ):
+            assert records[name].int8_head_dim, name
+        for name in (
+            "serve.dense.int8_gather.decode",
+            "serve.paged.int8_gather.decode",
+        ):
+            assert records[name].int8_head_dim is None, name
 
     def test_donation_counts_are_exact_not_vacuous(self):
         """The lowered dense decode aliases exactly its cache leaves:

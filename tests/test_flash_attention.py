@@ -356,6 +356,7 @@ def test_auto_block_floor_falls_back_to_dense():
     import numpy as np
 
     from distributeddeeplearning_tpu.ops.flash_attention import (
+        _WARNED_FALLBACKS,
         _auto_block,
         flash_attention,
     )
@@ -368,8 +369,16 @@ def test_auto_block_floor_falls_back_to_dense():
         jnp.asarray(rng.standard_normal((1, s, 1, 8)), jnp.float32)
         for _ in range(3)
     )
+    _WARNED_FALLBACKS.clear()  # a prior test may have burned this shape
     with pytest.warns(UserWarning, match="below the 128 floor"):
         out = flash_attention(q, k, v, None, dtype=jnp.float32, causal=True)
+
+    # warn-once per shape class: the second identical call must be
+    # SILENT (serve loops hit the fallback every step — a per-call
+    # warning floods stderr without adding information)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        flash_attention(q, k, v, None, dtype=jnp.float32, causal=True)
 
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(8.0)
     scores = jnp.where(jnp.tril(jnp.ones((s, s), bool)), scores, -1e30)

@@ -348,6 +348,36 @@ def test_obs_schema_rejects_drift(tmp_path):
         validate_artifact(str(badp99))
 
 
+def test_attribute_regression_across_phase_schemas():
+    """Cross-revision attribution: comparing an old-schema breakdown
+    (``attention_mlp_other``) against the PR-12 split must NOT report a
+    candidate-only phase's whole time as 'growth' — one-sided phases
+    land in ``unmatched_phases`` and deltas cover only shared phases."""
+    from distributeddeeplearning_tpu.obs.profile import attribute_regression
+
+    old = {
+        "decode_step_ms": 200.0,
+        "phases_ms": {"page_gather": 5.0, "scale_dequant": 0.0,
+                      "attention_mlp_other": 150.0},
+    }
+    new = {
+        "decode_step_ms": 210.0,
+        "phases_ms": {"page_gather": 6.0, "scale_dequant": 0.0,
+                      "attention_kernel": 90.0, "mlp_other": 80.0},
+    }
+    out = attribute_regression(old, new)
+    assert set(out["phase_delta_ms"]) == {"page_gather", "scale_dequant"}
+    assert out["unmatched_phases"] == [
+        "attention_kernel", "attention_mlp_other", "mlp_other"
+    ]
+    # a same-schema comparison still names the grown phase
+    new2 = dict(new, phases_ms=dict(new["phases_ms"], attention_kernel=120.0))
+    out2 = attribute_regression(new, new2)
+    assert out2["hottest_phase"] == "attention_kernel"
+    assert out2["hottest_phase_delta_ms"] == 30.0
+    assert "unmatched_phases" not in out2
+
+
 # --- bench --obs CPU smoke ------------------------------------------------
 
 @pytest.mark.timeout(280)

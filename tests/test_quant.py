@@ -379,7 +379,8 @@ def test_int8_weights_plus_kv_serve_end_to_end(params):
 @pytest.mark.timeout(240)
 def test_bench_quant_cpu_smoke(tmp_path):
     """Fast tier-1 smoke: bench.py --quant with a hard --steps-cap so the
-    three-engine comparison + fidelity probe can never hang CI."""
+    five-engine comparison (flash + gather exhibits) + fidelity probe
+    can never hang CI."""
     report = tmp_path / "quant.json"
     proc = subprocess.run(
         [
@@ -395,7 +396,11 @@ def test_bench_quant_cpu_smoke(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     line = json.loads(proc.stdout.strip().splitlines()[-1])
     assert line["value"] <= 0.55  # int8 kv bytes ratio, scales included
-    assert set(line["configs"]) == {"f32", "kv_int8", "kv_w_int8"}
+    assert set(line["configs"]) == {
+        "f32", "kv_int8", "kv_w_int8",
+        # PR 12: the legacy gather exhibits ride in the same artifact
+        "f32_gather", "kv_int8_gather",
+    }
     assert line["configs"]["kv_int8"]["kv_dtype"] == "int8"
     assert line["configs"]["kv_w_int8"]["weights_dtype"] == "int8"
     assert line["fidelity_probe"]["kv_int8"]["positions"] > 0
