@@ -42,7 +42,7 @@ V100_TF_CNN_BENCHMARKS_IMG_SEC = 720.0
 #: ``_rNN`` suffix (the drift that left COMMS at r09 while RESILIENCE sat
 #: at r07).  Committed artifacts keep their historical names; NEW runs
 #: write ``<KIND>_r{BENCH_REVISION}.json``.
-BENCH_REVISION = 15
+BENCH_REVISION = 16
 
 
 def artifact_name(kind: str) -> str:
@@ -2240,6 +2240,441 @@ def _run_serve_faults(args) -> int:
     return 0
 
 
+def _run_ckpt_faults(args) -> int:
+    """Durable-state chaos benchmark (``train/checkpoint.py`` manifests +
+    verified restore + live fleet weight reload) — the
+    ``CKPT_DURABLE_*.json`` artifact.  Gates (return code 1 on violation):
+
+    - **resume exact / zero bricked**: with ``ckpt_corrupt`` injected on
+      the LATEST generation of a real training run, a fresh restore lands
+      on the newest VERIFIED generation at the exact step, and the
+      Trainer resumes from there to completion — no exception, no
+      restart-loop, one generation of progress lost;
+    - **every corruption mode recovered**: flip / truncate / unlink /
+      manifest plus a torn writer (``ckpt_torn``) each leave the store
+      restorable from the previous generation;
+    - **reload bit-identical**: a 2-replica fleet serves a batch, live-
+      reloads a different checkpoint's weights
+      (``FleetRouter.reload``), serves a second batch — whose greedy
+      tokens must be BIT-IDENTICAL to a fresh engine started from that
+      checkpoint;
+    - **verify overhead**: manifest build + verification wall under
+      ``--ckpt-verify-overhead-limit`` %% of the save wall.
+    """
+    import dataclasses as _dc
+    import shutil
+    import tempfile
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributeddeeplearning_tpu.data.synthetic import SyntheticDataset
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        init_params,
+    )
+    from distributeddeeplearning_tpu.obs.registry import get_registry
+    from distributeddeeplearning_tpu.obs import trace as trace_mod
+    from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh
+    from distributeddeeplearning_tpu.serve import (
+        ContinuousBatchingScheduler,
+        PagedInferenceEngine,
+        ReplicaSpec,
+        Request,
+        synthetic_requests,
+    )
+    from distributeddeeplearning_tpu.serve.fleet import FleetRouter
+    from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
+    from distributeddeeplearning_tpu.train.loop import Trainer, TrainerConfig
+    from distributeddeeplearning_tpu.train.state import (
+        create_train_state,
+        sgd_momentum,
+    )
+    from distributeddeeplearning_tpu.train.step import build_train_step
+    from distributeddeeplearning_tpu.utils import faults as faults_mod
+
+    work_dir = tempfile.mkdtemp(prefix="ddlt-ckpt-faults-")
+    reg = get_registry()
+
+    @_dc.dataclass
+    class _MiniState:
+        """Minimal TrainState stand-in for checkpoint-layer phases that
+        need no optimizer (the Checkpointer only touches these fields)."""
+
+        step: object
+        params: object
+        opt_state: object
+        batch_stats: object
+
+        def replace(self, **kw):
+            return _dc.replace(self, **kw)
+
+    # ---- phase A: verified saves + corrupt-latest resume (real Trainer)
+    img, ncls, batch = (24, 24, 3), 7, 16
+    mesh = create_mesh(MeshSpec())
+    if args.small:
+        # CI smoke: a dense head instead of resnet18 — the durability
+        # machinery under test is model-agnostic, and the smoke runs as
+        # a subprocess NEXT TO a pytest-held jax session, where two
+        # resnet compiles have been observed to OOM-crash the box
+        import flax.linen as nn
+
+        class _TinyBenchModel(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                return nn.Dense(ncls)(x.reshape((x.shape[0], -1)))
+
+        model = _TinyBenchModel()
+    else:
+        model = get_model("resnet18", num_classes=ncls, dtype=jnp.float32)
+    tx = sgd_momentum(optax.constant_schedule(0.05))
+
+    def mk_state():
+        return create_train_state(jax.random.key(0), model, (8, *img), tx)
+
+    train_step = build_train_step(mesh, mk_state(), compute_dtype=jnp.float32)
+    ds = SyntheticDataset(length=4096, image_shape=img, num_classes=ncls)
+    batches = list(ds.batches(batch))
+
+    def factory(start_step: int):
+        def gen():
+            i = start_step
+            while True:
+                yield batches[i % len(batches)]
+                i += 1
+
+        return gen()
+
+    steps_per_epoch, epochs, every = 4, 2, 2
+    total_steps = steps_per_epoch * epochs
+    ckpt_dir = f"{work_dir}/train"
+    cfg = TrainerConfig(
+        epochs=epochs, steps_per_epoch=steps_per_epoch,
+        global_batch_size=batch, log_every=100,
+        checkpoint_dir=ckpt_dir, checkpoint_every_steps=every,
+        prefetch=0,
+    )
+    n_generations = total_steps // every
+    print(
+        f"[ckpt-faults] training {total_steps} steps, checkpoint every "
+        f"{every} -> {n_generations} generations, faults: "
+        f"{args.ckpt_faults_spec}", file=sys.stderr,
+    )
+    faults_mod.install_plan(args.ckpt_faults_spec)
+    tracer = trace_mod.set_tracer(
+        trace_mod.Tracer(enabled=True, annotate=False)
+    )
+    try:
+        Trainer(mesh, train_step, config=cfg).fit(mk_state(), factory)
+    finally:
+        trace_mod.set_tracer(trace_mod.Tracer(enabled=False))
+    faults_injected = faults_mod.get_plan().report()
+    faults_mod.install_plan("")  # the resume must run fault-free
+
+    # the training Trainer's checkpointer is out of scope after fit; a
+    # fresh one measures the resume.  Expected: the corrupt LATEST
+    # generation (step 8) fails verification, the walk falls back to the
+    # newest verified one (step 6) — exactly one generation of progress.
+    # The fallback must be OBSERVABLE: obs event + counter + a flight-
+    # recorder dump naming the failed generation (tracer enabled around
+    # exactly this restore so the artifact carries the evidence).
+    from distributeddeeplearning_tpu.obs.recorder import get_recorder
+
+    expected_step = total_steps - every
+    verify_failures_before = reg.counter("ckpt.verify_failures").value
+    get_recorder().drain_dumps()
+    resume_tracer = trace_mod.set_tracer(
+        trace_mod.Tracer(enabled=True, annotate=False)
+    )
+    try:
+        ckpt = Checkpointer(ckpt_dir)
+        try:
+            state, resumed_step = ckpt.restore(mk_state())
+        finally:
+            ckpt.close()
+    finally:
+        trace_mod.set_tracer(trace_mod.Tracer(enabled=False))
+    verify_failures = (
+        reg.counter("ckpt.verify_failures").value - verify_failures_before
+    )
+    verify_events = [
+        ev for ev in resume_tracer.events
+        if ev.get("name") == "ckpt/verify_failed"
+    ]
+    ckpt_dumps = [
+        d for d in get_recorder().drain_dumps()
+        if d.get("reason") == "ckpt_verify_failed"
+    ]
+    resume_exact = (
+        resumed_step == expected_step
+        and int(np.asarray(state.step)) == expected_step
+    )
+    print(
+        f"[ckpt-faults] corrupt-latest resume: restored step "
+        f"{resumed_step} (expected {expected_step}), "
+        f"{verify_failures} verification failure(s) recorded",
+        file=sys.stderr,
+    )
+    # ... and the REAL loop trains on from the fallback to completion —
+    # the no-brick half of the gate (restore above proved the step)
+    bricked = False
+    try:
+        final_state, _ = Trainer(mesh, train_step, config=cfg).fit(
+            mk_state(), factory
+        )
+        resumed_to_end = int(np.asarray(final_state.step)) == total_steps
+    except Exception as exc:  # noqa: BLE001 — a brick IS the failure mode
+        print(f"[ckpt-faults] resume run BRICKED: {exc}", file=sys.stderr)
+        bricked = True
+        resumed_to_end = False
+
+    # ---- phase B: every corruption mode recovers to the previous gen
+    tiny = _MiniState(
+        step=jnp.int32(0),
+        params={"w": jnp.arange(4096, dtype=jnp.float32).reshape(64, 64)},
+        opt_state={}, batch_stats={},
+    )
+    corrupt_modes = {}
+    for mode in ("flip", "truncate", "unlink", "manifest", "torn"):
+        mdir = f"{work_dir}/mode-{mode}"
+        spec_text = (
+            "ckpt_torn@2" if mode == "torn"
+            else f"ckpt_corrupt@2:mode={mode}"
+        )
+        faults_mod.install_plan(spec_text)
+        c = Checkpointer(mdir)
+        try:
+            c.save(1, tiny.replace(step=jnp.int32(1)))
+            c.save(2, tiny.replace(step=jnp.int32(2)))
+            c.wait()
+            recovered, fallback_step = False, None
+            try:
+                _, fallback_step = c.restore(tiny)
+                recovered = fallback_step == 1
+            except Exception as exc:  # noqa: BLE001 — recovery gate data
+                print(
+                    f"[ckpt-faults] mode {mode}: restore raised "
+                    f"{type(exc).__name__}: {exc}", file=sys.stderr,
+                )
+        finally:
+            c.close()
+            faults_mod.install_plan("")
+        corrupt_modes[mode] = {
+            "spec": spec_text,
+            "recovered": bool(recovered),
+            "fallback_step": fallback_step,
+        }
+        print(
+            f"[ckpt-faults] mode {mode}: recovered={recovered} "
+            f"(fallback step {fallback_step})", file=sys.stderr,
+        )
+
+    # ---- phase C: verify overhead vs save wall (fault-free saves of the
+    # real train state — the number a production run pays per generation).
+    # The denominator is the FULL persist wall of the generations (save
+    # dispatches + the drain that lands them); the numerator is the wall
+    # the durability layer ADDED to that path — host snapshot + finalize
+    # joins — while the checksum CPU work itself overlaps the async write
+    # (reported separately as verify_cpu_s).
+    over = Checkpointer(f"{work_dir}/overhead", max_to_keep=3)
+    try:
+        st = mk_state()
+        t0 = _time.perf_counter()
+        for i in range(1, 5):
+            over.save(i, st.replace(step=jnp.int32(i)))
+        over.wait()
+        persist_wall = _time.perf_counter() - t0
+        save_wall = persist_wall
+        verify_wall = over.verify_wall_s
+        verify_cpu = over.verify_cpu_s
+        snapshot_wall = over.snapshot_wall_s
+    finally:
+        over.close()
+    overhead_pct = round(100.0 * verify_wall / max(save_wall, 1e-9), 2)
+    print(
+        f"[ckpt-faults] verify overhead: {verify_wall * 1e3:.1f}ms added "
+        f"to a {save_wall * 1e3:.1f}ms persist wall = {overhead_pct}% "
+        f"(checksum CPU overlapped with the write: {verify_cpu * 1e3:.1f}ms; "
+        f"donation-safety snapshot memcpy, paid by any correct async "
+        f"save: {snapshot_wall * 1e3:.1f}ms)",
+        file=sys.stderr,
+    )
+
+    # ---- phase D: live weight reload across the fleet, pinned against a
+    # fresh engine from the reloaded checkpoint
+    dims = dict(num_layers=2, d_model=64, num_heads=4, d_ff=128,
+                vocab_size=257)
+    max_seq = 48
+    p_old = init_params(jax.random.key(1), max_len=max_seq, **dims)
+    p_new = init_params(jax.random.key(2), max_len=max_seq, **dims)
+    dir_old, dir_new = f"{work_dir}/w-old", f"{work_dir}/w-new"
+    for d, p in ((dir_old, p_old), (dir_new, p_new)):
+        c = Checkpointer(d)
+        try:
+            c.save(1, _MiniState(
+                step=jnp.int32(1), params=p, opt_state={}, batch_stats={},
+            ))
+            c.wait()
+        finally:
+            c.close()
+    spec = ReplicaSpec(
+        checkpoint_dir=dir_old,
+        num_heads=dims["num_heads"], batch_slots=2, max_seq=max_seq,
+        kv_layout="paged", page_size=8, prefill_chunk=8,
+        temperature=0.0, max_new_tokens=12,
+    )
+    batch_a = synthetic_requests(
+        6, vocab_size=dims["vocab_size"], max_prompt=10,
+        rng=np.random.default_rng(0),
+    )
+    batch_b = [
+        Request(uid=f"post-reload-{i}", prompt=r.prompt)
+        for i, r in enumerate(synthetic_requests(
+            6, vocab_size=dims["vocab_size"], max_prompt=10,
+            rng=np.random.default_rng(1),
+        ))
+    ]
+    replicas = 2
+    print(
+        f"[ckpt-faults] fleet reload: {replicas} replicas, "
+        f"{len(batch_a)}+{len(batch_b)} requests", file=sys.stderr,
+    )
+    router = FleetRouter(spec, replicas=replicas, faults="")
+    _, rep_a = router.serve(batch_a, shutdown=False)
+    acks = router.reload(dir_new)
+    res_b, rep_b = router.serve(batch_b)
+    acks_ok = sum(1 for a in acks.values() if a.get("ok"))
+    # the reference: a fresh engine built from the reloaded checkpoint
+    ref_ckpt = Checkpointer(dir_new)
+    try:
+        ref_params, _ = ref_ckpt.restore_params()
+    finally:
+        ref_ckpt.close()
+    ref_engine = PagedInferenceEngine(
+        ref_params, num_heads=dims["num_heads"], batch_slots=2,
+        max_seq=max_seq, page_size=8, prefill_chunk=8, temperature=0.0,
+        rng=jax.random.key(spec.seed),
+    )
+    ref_res, _ = ContinuousBatchingScheduler(
+        ref_engine, max_new_tokens=12,
+    ).run([Request(uid=r.uid, prompt=r.prompt) for r in batch_b])
+    ref_tokens = {r.uid: list(r.tokens) for r in ref_res}
+    mismatched = [
+        r.uid for r in res_b
+        if r.finish_reason in ("eos", "length")
+        and list(r.tokens) != ref_tokens[r.uid]
+    ]
+    reload_ok = (
+        acks_ok == replicas
+        and rep_b.completed_ok == len(batch_b)
+        and not mismatched
+    )
+    print(
+        f"[ckpt-faults] reload: {acks_ok}/{replicas} acks, "
+        f"bit_identical={not mismatched}", file=sys.stderr,
+    )
+
+    gates = {
+        "resume_exact": bool(resume_exact),
+        "zero_bricked": bool(not bricked and resumed_to_end),
+        "corrupt_modes_recovered": all(
+            m["recovered"] for m in corrupt_modes.values()
+        ),
+        "reload_bit_identical": bool(reload_ok),
+        "verify_overhead_under_limit": (
+            overhead_pct < args.ckpt_verify_overhead_limit
+        ),
+        # the fallback left evidence: a ckpt/verify_failed obs event AND
+        # a flight-recorder dump, each naming the corrupt generation
+        "fallback_observable": bool(
+            any(
+                isinstance(ev.get("args"), dict)
+                and ev["args"].get("step") == total_steps
+                for ev in verify_events
+            )
+            and any(
+                d.get("generation") == total_steps for d in ckpt_dumps
+            )
+        ),
+    }
+    line = {
+        "metric": "ckpt_durable_verify_overhead_pct",
+        "value": overhead_pct,
+        "unit": "%",
+        "vs_baseline": None,
+        "bench_revision": BENCH_REVISION,
+        "faults_spec": args.ckpt_faults_spec,
+        "faults_injected": faults_injected,
+        "resume": {
+            "total_steps": total_steps,
+            "checkpoint_every_steps": every,
+            "corrupt_step": total_steps,
+            "expected_step": int(expected_step),
+            "resumed_step": int(resumed_step) if resumed_step else -1,
+            "exact": bool(resume_exact),
+            "resumed_to_end": bool(resumed_to_end),
+            "verify_failures_observed": int(verify_failures),
+            "verify_failed_events": len(verify_events),
+            "failed_generations": sorted({
+                ev["args"].get("step") for ev in verify_events
+                if isinstance(ev.get("args"), dict)
+            }) if verify_events else [],
+            "failed_leaf": next(
+                (
+                    ev["args"].get("leaf") for ev in verify_events
+                    if isinstance(ev.get("args"), dict)
+                    and ev["args"].get("leaf")
+                ),
+                None,
+            ),
+            "flight_recorder_dumps": len(ckpt_dumps),
+        },
+        "corrupt_modes": corrupt_modes,
+        "reload": {
+            "replicas": replicas,
+            "acks": acks_ok,
+            "ack_detail": {str(k): v for k, v in acks.items()},
+            "requests": len(batch_b),
+            "completed_ok": rep_b.completed_ok,
+            "bit_identical": not mismatched,
+            "mismatched_uids": mismatched,
+            "fleet_reloads": rep_b.reloads,
+            "pre_reload_completed_ok": rep_a.completed_ok,
+        },
+        "verify_overhead": {
+            "save_wall_s": round(save_wall, 4),
+            "verify_wall_s": round(verify_wall, 4),
+            "verify_cpu_overlapped_s": round(verify_cpu, 4),
+            # the donation-safety memcpy: a CORRECT async save with
+            # donated states pays this with or without manifests (the
+            # background write would otherwise alias the donated buffer)
+            "snapshot_wall_s": round(snapshot_wall, 4),
+            "pct": overhead_pct,
+            "limit_pct": args.ckpt_verify_overhead_limit,
+        },
+        "gates": gates,
+        "platform": jax.default_backend(),
+        "virtual_pod": _is_virtual_pod(),
+    }
+    shutil.rmtree(work_dir, ignore_errors=True)
+    print(json.dumps({
+        k: line[k] for k in ("metric", "value", "unit", "vs_baseline",
+                             "faults_spec", "gates")
+    }))
+    report_path = args.report or artifact_name("CKPT_DURABLE")
+    with open(report_path, "w") as f:
+        json.dump(line, f, indent=2)
+        f.write("\n")
+    print(f"[ckpt-faults] report -> {report_path}", file=sys.stderr)
+    if not all(gates.values()):
+        print(f"[ckpt-faults] GATES FAILED: {gates}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _run_comms(args) -> int:
     """Gradient-communication benchmark: the explicit comm_overlap schedule
     (``parallel/comms.py`` — bucketed reduce-scatter in the accumulation
@@ -2878,6 +3313,30 @@ def main() -> int:
         "never got its capacity back)",
     )
     parser.add_argument(
+        "--ckpt-faults",
+        action="store_true",
+        help="durable-state chaos benchmark: verified checkpoint "
+        "generations under injected corruption (ckpt_corrupt / "
+        "ckpt_torn), corrupt-latest training resume landing on the exact "
+        "newest VERIFIED step, live weight reload across a serving fleet "
+        "pinned bit-identical to a fresh engine, and the manifest verify-"
+        "overhead budget; emits CKPT_DURABLE_r{NN}.json",
+    )
+    parser.add_argument(
+        "--ckpt-faults-spec",
+        default="ckpt_corrupt@4:mode=flip",
+        help="DDLT_FAULTS schedule for the --ckpt-faults training phase "
+        "(generation-opportunity keyed: @4 corrupts the 4th — latest — "
+        "finalized generation of the run)",
+    )
+    parser.add_argument(
+        "--ckpt-verify-overhead-limit",
+        type=float,
+        default=10.0,
+        help="verify-overhead gate for --ckpt-faults (manifest build + "
+        "verification wall as a percent of the save wall)",
+    )
+    parser.add_argument(
         "--serve-overhead-limit",
         type=float,
         default=30.0,
@@ -2970,6 +3429,13 @@ def main() -> int:
         parser.error(
             "--serve-faults needs --serve-replicas >= 2 (replica_death "
             "must leave a survivor to fail over to)"
+        )
+    if args.ckpt_faults and (args.serve or args.devices or args.data
+                             or args.faults or args.comms or args.quant
+                             or args.obs or args.obs_fleet or args.spec
+                             or args.serve_faults):
+        parser.error(
+            "--ckpt-faults is exclusive with the other benchmark modes"
         )
     if args.comms:
         if args.serve or args.devices or args.data or args.faults:
@@ -3079,6 +3545,8 @@ def main() -> int:
         return _run_faults(args)
     if args.serve_faults:
         return _run_serve_faults(args)
+    if args.ckpt_faults:
+        return _run_ckpt_faults(args)
     if args.quant:
         return _run_quant(args)
     if args.spec:

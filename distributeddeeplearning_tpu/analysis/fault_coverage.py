@@ -40,6 +40,8 @@ KIND_HOOKS: Dict[str, Tuple[str, ...]] = {
     "decode_nan": ("take_decode_nan",),
     "decode_stall": ("take_decode_stall",),
     "reject_admit": ("maybe_reject_admit",),
+    "ckpt_corrupt": ("take_ckpt_corrupt",),
+    "ckpt_torn": ("take_ckpt_torn",),
 }
 
 

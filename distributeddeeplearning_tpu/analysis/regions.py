@@ -94,6 +94,19 @@ HOT_REGIONS: Tuple[HotRegion, ...] = (
         landmarks=("outbox.put(", "get_registry().state()"),
         sync_budget=0,
     ),
+    HotRegion(
+        name="fleet-reload-apply",
+        module="distributeddeeplearning_tpu.serve.fleet",
+        qualname="_apply_reload",
+        # the live-reload body runs INSIDE the serve loop (the scheduler's
+        # idle barrier): host checkpoint I/O plus one device_put upload by
+        # design — a device READBACK here stalls the whole fleet's reload
+        # barrier on a sync it never needed.  The landmarks pin the
+        # verified-restore -> in-place-swap shape (a refactor that skips
+        # verification or rebuilds the engine fails lint, not review).
+        landmarks=("restore_params(", "reload_params("),
+        sync_budget=0,
+    ),
 )
 
 #: Jitted step builders: no host-sync token at all — inside jit it would

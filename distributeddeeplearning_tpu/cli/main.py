@@ -1178,15 +1178,18 @@ def _cmd_train(args, extra: List[str]) -> int:
         return module.main(**kwargs)
 
     def latest_ckpt_step() -> int:
-        from pathlib import Path
+        # VERIFIED generations only (train/checkpoint.py manifests): the
+        # supervisor's recovery accounting must count from the step a
+        # restart can actually restore — a corrupt/torn latest generation
+        # is not it (legacy manifest-less dirs still read as before)
+        from distributeddeeplearning_tpu.train.checkpoint import (
+            latest_verified_step_in_dir,
+        )
 
         ckpt_dir = kwargs.get("save_filepath")
-        if not ckpt_dir or not Path(ckpt_dir).exists():
+        if not ckpt_dir:
             return 0
-        steps = [
-            int(p.name) for p in Path(ckpt_dir).iterdir() if p.name.isdigit()
-        ]
-        return max(steps, default=0)
+        return latest_verified_step_in_dir(ckpt_dir) or 0
 
     redone = {"steps": 0}
 
@@ -1377,7 +1380,13 @@ def _cmd_serve(args) -> int:
             print(f"no checkpoint under {args.checkpoint_dir}",
                   file=sys.stderr)
             return 1
-        print(f"[serve] restored params at step {step}", file=sys.stderr)
+        # restore_params walks generations newest-first and verifies each
+        # candidate against its manifest (train/checkpoint.py) — a corrupt
+        # latest falls back instead of serving garbage weights
+        print(
+            f"[serve] restored verified params at step {step}",
+            file=sys.stderr,
+        )
     num_heads = args.num_heads if args.num_heads is not None else 4
     vocab = params["head"].shape[1] if params is not None else args.vocab_size
 

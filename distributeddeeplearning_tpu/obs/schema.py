@@ -27,7 +27,12 @@ committed revision artifact:
   event digest) — the evidence the fleet's failover story rests on;
 - ``SPEC_*`` artifacts validate against the speculative-decoding schema
   (per-drafter acceptance_rate in [0, 1], tokens_per_verify >= 1, the
-  bit-identical and decode-speedup gate booleans).
+  bit-identical and decode-speedup gate booleans);
+- ``CKPT_DURABLE_*`` artifacts validate against the durable-state schema
+  (corrupt-latest resume landing on the exact verified step, a per-
+  corruption-mode recovery matrix, the live-reload bit-exactness verdict
+  and the verify-overhead budget) — the evidence the checkpoint layer's
+  "storage is not trusted" story rests on.
 """
 
 from __future__ import annotations
@@ -42,6 +47,7 @@ __all__ = [
     "validate_obs_fleet_payload",
     "validate_serve_resilience_payload",
     "validate_spec_payload",
+    "validate_ckpt_durable_payload",
 ]
 
 #: latency blocks whose percentile keys are a cross-artifact contract
@@ -446,6 +452,99 @@ def validate_spec_payload(payload: Dict[str, Any]) -> None:
         raise SchemaError("; ".join(errors))
 
 
+def validate_ckpt_durable_payload(payload: Dict[str, Any]) -> None:
+    """Strict schema for the ``CKPT_DURABLE_r{NN}.json`` artifact body.
+
+    Durable state's evidence trail: with a corrupt latest generation
+    injected, training resumed from the newest VERIFIED generation at the
+    exact step (no brick), every corruption mode recovered, post-reload
+    fleet tokens are bit-identical to a fresh engine from the same
+    checkpoint, and manifest verification stayed inside its overhead
+    budget.
+    """
+    errors: List[str] = []
+
+    def require(cond: bool, msg: str) -> None:
+        if not cond:
+            errors.append(msg)
+
+    for key in ("metric", "value", "unit", "bench_revision", "platform",
+                "virtual_pod", "faults_spec", "resume", "corrupt_modes",
+                "reload", "verify_overhead", "gates"):
+        require(key in payload, f"missing top-level key {key!r}")
+
+    resume = payload.get("resume")
+    if isinstance(resume, dict):
+        require(
+            isinstance(resume.get("exact"), bool),
+            "resume.exact must be a bool",
+        )
+        for key in ("expected_step", "resumed_step"):
+            require(
+                isinstance(resume.get(key), int),
+                f"resume.{key} must be an int",
+            )
+        require(
+            isinstance(resume.get("verify_failures_observed"), int)
+            and resume.get("verify_failures_observed", 0) > 0,
+            "resume.verify_failures_observed must be a positive int (a "
+            "CKPT_DURABLE artifact must come from a chaos run — no "
+            "verification failure means no fallback was exercised)",
+        )
+    else:
+        require(False, "resume must be a dict")
+
+    modes = payload.get("corrupt_modes")
+    if isinstance(modes, dict) and modes:
+        for name, m in modes.items():
+            require(
+                isinstance(m, dict) and isinstance(m.get("recovered"), bool),
+                f"corrupt_modes[{name!r}].recovered must be a bool",
+            )
+    else:
+        require(False, "corrupt_modes must be a non-empty dict (one entry "
+                       "per injected corruption mode)")
+
+    reload_block = payload.get("reload")
+    if isinstance(reload_block, dict):
+        require(
+            isinstance(reload_block.get("bit_identical"), bool),
+            "reload.bit_identical must be a bool",
+        )
+        require(
+            isinstance(reload_block.get("acks"), int)
+            and isinstance(reload_block.get("replicas"), int),
+            "reload.acks / reload.replicas must be ints",
+        )
+    else:
+        require(False, "reload must be a dict")
+
+    overhead = payload.get("verify_overhead")
+    if isinstance(overhead, dict):
+        for key in ("save_wall_s", "verify_wall_s", "pct", "limit_pct"):
+            require(
+                isinstance(overhead.get(key), (int, float)),
+                f"verify_overhead.{key} must be numeric",
+            )
+    else:
+        require(False, "verify_overhead must be a dict")
+
+    gates = payload.get("gates")
+    if isinstance(gates, dict):
+        for gk in ("resume_exact", "zero_bricked",
+                   "corrupt_modes_recovered", "reload_bit_identical",
+                   "verify_overhead_under_limit", "fallback_observable"):
+            require(
+                isinstance(gates.get(gk), bool),
+                f"gates.{gk} must be a bool",
+            )
+    else:
+        require(False, "gates must be a dict")
+
+    if errors:
+        raise SchemaError("; ".join(errors))
+
+
 def validate_artifact(path: str) -> Any:
     """Validate one committed artifact file; returns the parsed JSON.
 
@@ -491,6 +590,11 @@ def validate_artifact(path: str) -> Any:
     if base.startswith("SPEC_") and isinstance(data, dict):
         try:
             validate_spec_payload(data)
+        except SchemaError as exc:
+            errors.append(str(exc))
+    if base.startswith("CKPT_DURABLE_") and isinstance(data, dict):
+        try:
+            validate_ckpt_durable_payload(data)
         except SchemaError as exc:
             errors.append(str(exc))
 

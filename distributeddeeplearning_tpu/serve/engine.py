@@ -109,6 +109,31 @@ def prompt_bucket(n: int, max_seq: int, floor: int = 8) -> int:
     return min(b, max_seq)
 
 
+def _check_reload_tree(old, new) -> None:
+    """Reload admissibility: the new weight set must be drop-in for the
+    compiled programs — same pytree structure, and every leaf aval
+    (shape, dtype) identical.  Anything else would silently recompile
+    every decode program mid-serve (or worse, reshape K/V math); refuse
+    loudly instead."""
+    if jax.tree_util.tree_structure(new) != jax.tree_util.tree_structure(old):
+        raise ValueError(
+            "reload_params: new params tree structure differs from the "
+            "engine's (different model family / quantization state?) — "
+            "a live reload must be weight-value-only"
+        )
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(old)[0],
+        jax.tree_util.tree_flatten_with_path(new)[0],
+    ):
+        if a.shape != b.shape or a.dtype != b.dtype:
+            raise ValueError(
+                "reload_params: leaf "
+                f"{jax.tree_util.keystr(path)} changed aval "
+                f"({a.shape}/{a.dtype} -> {b.shape}/{b.dtype}) — same-"
+                "shape weight sets only (compiled programs stay live)"
+            )
+
+
 def _validate_model_dims(params, *, num_heads: int, max_seq: int, top_k):
     """Construction-time checks both engine layouts share; returns
     ``(d_model, num_layers, head_dim)`` from the param shapes."""
@@ -237,6 +262,7 @@ class InferenceEngine:
         )
 
         sharded = mesh is not None and mesh.devices.size > 1
+        self._params_sharding = None  # reload re-places onto the same layout
         if sharded:
             if batch_slots % int(np.prod(
                 [mesh.shape[a] for a in ("data", "fsdp")]
@@ -253,6 +279,7 @@ class InferenceEngine:
             rep = NamedSharding(mesh, P())
             slot_vec = NamedSharding(mesh, P(DATA_AXES))
             p_shard = jax.tree_util.tree_map(lambda _: rep, params)
+            self._params_sharding = p_shard
             self.params = jax.device_put(params, p_shard)
             self._cache = jax.device_put(self._cache, c_shard)
             decode_in = (p_shard, c_shard, slot_vec, slot_vec, rep)
@@ -447,6 +474,23 @@ class InferenceEngine:
         self._cache = self._scrub_jit(
             self._cache, jnp.int32(slot), jnp.int32(from_pos)
         )
+
+    # -- live weight reload ------------------------------------------------
+    def reload_params(self, params) -> None:
+        """Swap the engine's weight set IN PLACE — the live-reload verb.
+
+        Same tree / shapes / dtypes only (:func:`_check_reload_tree`), so
+        every compiled program (params travel as jit ARGUMENTS, keyed on
+        avals) and the KV cache buffers stay untouched — the swap is one
+        ``device_put`` onto the engine's existing param layout.  The
+        scheduler applies reloads only at an idle barrier between decode
+        steps (``request_reload``), so no request ever sees two weight
+        sets."""
+        _check_reload_tree(self.params, params)
+        if self._params_sharding is not None:
+            params = jax.device_put(params, self._params_sharding)
+        self.params = params
+        logger.info("engine: params reloaded in place (dense layout)")
 
 
 class PrefillTask:
@@ -985,3 +1029,30 @@ class PagedInferenceEngine:
         for page in self._slot_pages.pop(slot, []):
             self.allocator.decref(page)
         self._block_tables[slot] = SCRATCH_PAGE
+
+    # -- live weight reload ------------------------------------------------
+    def reload_params(self, params) -> None:
+        """Swap the engine's weight set IN PLACE (see the dense engine's
+        docstring for the same-avals contract — compiled programs and the
+        page pool stay untouched).
+
+        Paged extras: refuses while any slot holds pages (a live slot
+        spanning the swap would decode new-weight queries against
+        old-weight K/V — the scheduler's idle barrier guarantees this
+        never happens in serving), and DROPS the prefix table — cached
+        prefix pages hold K/V computed by the OLD weights, and a
+        post-reload hit on them would silently break the fresh-engine
+        bit-exactness contract."""
+        if self._slot_pages:
+            raise ValueError(
+                "reload_params with live slots "
+                f"{sorted(self._slot_pages)} — reload is a barrier "
+                "between requests; drain the slots first (the scheduler's "
+                "request_reload does)"
+            )
+        _check_reload_tree(self.params, params)
+        self.params = params
+        self.allocator.clear_prefix()
+        logger.info(
+            "paged engine: params reloaded in place, prefix cache dropped"
+        )

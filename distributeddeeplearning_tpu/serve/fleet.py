@@ -35,7 +35,17 @@ side):
   ``"preempted"``, and the CLI exits
   :data:`~..train.resilience.RESUMABLE_EXIT_CODE` (75) so the control
   plane's resubmit path (PR 2) brings the fleet back — serving joins
-  the same exit-code contract as training.
+  the same exit-code contract as training;
+- **live weight reload**: :meth:`FleetRouter.reload` broadcasts a
+  ``reload(ckpt_dir)`` control message down every replica's inbox FIFO;
+  each worker verifies + restores the checkpoint (the corruption-
+  tolerant path in ``train/checkpoint.py``) at its scheduler's idle
+  barrier — between decode steps, active requests drained first — and
+  swaps the weight set in place (same shapes: compiled programs and KV
+  pages untouched, prefix cache dropped).  Greedy tokens after the
+  reload are bit-identical to a fresh engine started from that
+  checkpoint; a failed reload keeps the replica serving its OLD weights
+  and reports the error in the ack.
 
 Fault injection: the router **deals** the ``DDLT_FAULTS`` spec across
 replicas (:func:`..utils.faults.deal_serve_faults` — serve-side kinds go
@@ -162,6 +172,9 @@ class FleetReport:
     restarts: int = 0
     replica_deaths: int = 0
     redeliveries: int = 0
+    # live weight reloads the router broadcast AND every live replica
+    # acknowledged (serve/fleet.FleetRouter.reload)
+    reloads: int = 0
     lost_requests: int = 0     # redelivery budget exhausted
     shed: int = 0              # admission-rejected deliveries observed
     drained: bool = False
@@ -278,6 +291,37 @@ def _build_engine(spec: ReplicaSpec):
 #: periodic half of "periodic + at drain" — a replica that dies between
 #: ships loses at most this window of counter movement)
 METRICS_SHIP_INTERVAL_S = 0.5
+
+
+def _apply_reload(engine, spec: ReplicaSpec, ckpt_dir: str) -> Optional[int]:
+    """Verify + restore a checkpoint's params into the RUNNING engine.
+
+    The worker half of live weight reload, called by the scheduler at its
+    idle barrier (between decode steps, never mid-request): the restore
+    goes through the checkpoint layer's verified path — a corrupt latest
+    generation falls back to the newest verified one, exactly like a
+    restart would — then the engine swaps the weight set in place
+    (``reload_params``: same avals, compiled programs and KV pages
+    untouched, prefix cache dropped).  Returns the restored step.
+
+    Registered hot region (``fleet-reload-apply`` in
+    ``analysis/regions.py``, sync budget 0): everything here is host I/O
+    plus one ``device_put`` upload — a device READBACK on this path means
+    the reload is stalling the serve loop on a sync it never needed.
+    """
+    from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(ckpt_dir)
+    try:
+        params, step = ckpt.restore_params(
+            quantize_weights=spec.quantize_weights
+        )
+    finally:
+        ckpt.close()
+    if params is None:
+        raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+    engine.reload_params(params)
+    return step
 
 
 def _ship_metrics(outbox, replica_id: int) -> None:
@@ -406,6 +450,15 @@ def _worker_main(
             if msg is None:  # close sentinel: finish what we hold
                 closed = True
                 break
+            if msg.get("control") == "reload":
+                # live weight reload: the control message is a BARRIER in
+                # the per-replica FIFO — requests delivered before it are
+                # served by the old weights, requests after by the new —
+                # and the scheduler applies it only at its idle barrier
+                # (active work drains first, admission holds), so every
+                # request sees exactly one weight set end to end
+                schedule_reload(msg["ckpt_dir"])
+                continue
             fresh.append(
                 Request(
                     uid=msg["uid"],
@@ -416,6 +469,52 @@ def _worker_main(
                 )
             )
         return None if (closed and not fresh) else fresh
+
+    pending_reload_dir: List[Optional[str]] = [None]
+
+    def schedule_reload(ckpt_dir: str) -> None:
+        superseded = pending_reload_dir[0]
+        if superseded is not None and superseded != ckpt_dir:
+            # a second reload arrived before the first reached the idle
+            # barrier: last weight set wins, but the superseded
+            # broadcast's router-side reload() is owed a definitive
+            # answer — nack it instead of letting it time out
+            outbox.put((
+                "reload_error", replica_id,
+                {"ckpt_dir": superseded,
+                 "error": "superseded by a newer reload"},
+            ))
+        pending_reload_dir[0] = ckpt_dir
+
+        def do_reload() -> None:
+            if pending_reload_dir[0] == ckpt_dir:
+                pending_reload_dir[0] = None
+            try:
+                with tracer.span(
+                    "fleet/reload", cat="fleet", ckpt_dir=ckpt_dir,
+                ):
+                    step = _apply_reload(engine, spec, ckpt_dir)
+            except Exception as exc:  # noqa: BLE001 — old weights keep serving
+                logger.warning(
+                    "replica %d reload from %s FAILED: %s",
+                    replica_id, ckpt_dir, exc,
+                )
+                outbox.put((
+                    "reload_error", replica_id,
+                    {"ckpt_dir": ckpt_dir,
+                     "error": f"{type(exc).__name__}: {exc}"},
+                ))
+            else:
+                tracer.event(
+                    "fleet/reload_done", cat="fleet", replica=replica_id,
+                    ckpt_dir=ckpt_dir, step=step,
+                )
+                outbox.put((
+                    "reload_done", replica_id,
+                    {"ckpt_dir": ckpt_dir, "step": step},
+                ))
+
+        sched.request_reload(do_reload)
 
     def on_step(step: int) -> None:
         outbox.put(("hb", replica_id, step))
@@ -479,6 +578,15 @@ def _worker_main(
         export_shard()
         outbox.put(("crash", replica_id, f"{type(exc).__name__}: {exc}"))
         raise
+    if sched.has_pending_reload:
+        # the close sentinel beat the idle barrier: the reload never
+        # applied and never will — a definitive NACK beats letting the
+        # router's reload() wait out its whole ack timeout
+        outbox.put((
+            "reload_error", replica_id,
+            {"ckpt_dir": pending_reload_dir[0],
+             "error": "worker shut down before the reload applied"},
+        ))
     # the drain half of "periodic + at drain": the final state carries
     # the scheduler's end-of-run histogram rollup (TTFT/TPOT buckets)
     _ship_metrics(outbox, replica_id)
@@ -594,6 +702,18 @@ class FleetRouter:
         self.redeliveries = 0
         self.lost_requests = 0
         self.shed_seen = 0
+        self.reloads = 0
+        # reload acknowledgements by replica index (reload_done /
+        # reload_error payloads); reload() waits on these — filled by
+        # serve()'s dispatch loop when one is running, by reload()'s own
+        # idle pump otherwise
+        self._reload_acks: Dict[int, Dict[str, Any]] = {}
+        self._serving = False
+        # messages reload()'s idle pump read but must not consume: a
+        # serve() racing the pump re-dispatches these through its own
+        # process() before touching the outbox (dropping a 'done' here
+        # would strand its flight forever)
+        self._stashed_msgs: List[Any] = []
         # handshake clock-offset estimates, keyed by worker pid: the
         # ready message carries the worker tracer's wall-clock epoch, so
         # the shard merge can align each worker's perf_counter timeline
@@ -647,23 +767,208 @@ class FleetRouter:
         for sig in signals:
             signal.signal(sig, lambda *_: self.drain())
 
+    def _shutdown_members(self) -> None:
+        """Close inboxes, join workers, collect trailing reports.
+
+        A replica still mid-spawn (restarted near the end, engine not
+        built) is terminated instead of joined: every result is already
+        in, and waiting out a full jax import + engine compile would
+        bill cold-start arithmetic to the serving wall (its
+        replica_reports entry stays None).
+        """
+        for member in self._members:
+            if not member.dead:
+                try:
+                    member.inbox.put(None)
+                except (ValueError, OSError):
+                    pass
+        deadline = time.monotonic() + 60.0
+        for member in self._members:
+            if member.dead:
+                continue
+            if not member.ready:
+                member.proc.terminate()
+                member.proc.join(timeout=5.0)
+                continue
+            member.proc.join(timeout=max(0.5, deadline - time.monotonic()))
+            if member.proc.exitcode is None:
+                member.proc.terminate()
+                member.proc.join(timeout=5.0)
+        # Trailing messages: the dispatch loop exits the moment the last
+        # RESULT lands, but each worker's drain-time payload — its exit
+        # report, its FINAL metrics state (the one carrying the
+        # scheduler's end-of-run TTFT/TPOT histogram rollup) and any
+        # flight-recorder dumps — arrives after that, during shutdown.
+        # Dropping them here would leave the fleet merge with only the
+        # mid-run periodic ships.
+        while True:
+            try:
+                # short timeout, not get_nowait: the workers have exited,
+                # but the router-side queue thread may still be
+                # deserializing their final flush — one idle window
+                # bounds the wait
+                msg = self._outbox.get(timeout=0.25)
+            except queue_mod.Empty:
+                break
+            if msg[0] == "exit":
+                for member in self._members:
+                    if member.index == msg[1] and member.report is None:
+                        member.report = msg[2]
+            elif msg[0] == "metrics":
+                self._metric_states[(msg[1], msg[2])] = msg[3]
+            elif msg[0] == "dumps":
+                self._worker_dumps.extend(msg[2])
+            elif msg[0] in ("reload_done", "reload_error"):
+                # a reload() on another thread raced serve completion:
+                # its ack arrives in the drain-time flush — dropping it
+                # here would leave that reload() spinning out its whole
+                # timeout over a reload that resolved
+                payload = dict(msg[2])
+                payload["ok"] = msg[0] == "reload_done"
+                self._reload_acks[msg[1]] = payload
+        # every worker is gone: mark the members terminal so a later
+        # serve() respawns instead of dispatching onto dead inboxes, and
+        # reload() refuses instead of waiting out its whole timeout
+        for member in self._members:
+            member.dead = True
+            member.ready = False
+
+    # -- live weight reload ------------------------------------------------
+
+    def reload(
+        self, ckpt_dir: str, *, timeout_s: float = 300.0
+    ) -> Dict[int, Dict[str, Any]]:
+        """Broadcast a ``reload(ckpt_dir)`` control message to every live
+        READY replica and block until each acknowledges (or the timeout).
+
+        The message rides each replica's inbox FIFO, so it is a per-
+        replica ordering barrier: requests delivered before it are served
+        by the old weights, requests after by the new.  Each worker
+        verifies + restores the checkpoint at its scheduler's idle
+        barrier (between decode steps, active work drained first) and
+        swaps the weight set in place — compiled programs and KV pages
+        untouched, greedy tokens afterwards bit-identical to a fresh
+        engine started from that checkpoint.
+
+        Returns ``{replica_index: ack payload}`` (``ok`` False carries
+        the worker's error; a worker that failed keeps serving the OLD
+        weights).  Callable between :meth:`serve` calls
+        (``serve(shutdown=False)`` first) or from another thread while a
+        serve is running — the running dispatch loop harvests the acks.
+        """
+        targets = [m for m in self._members if not m.dead and m.ready]
+        if not targets:
+            raise RuntimeError(
+                "no live ready replica to reload — serve(shutdown=False) "
+                "first, or reload mid-serve from another thread"
+            )
+        self._reload_acks = {}
+        get_tracer().event(
+            "fleet/reload_begin", cat="fleet", ckpt_dir=str(ckpt_dir),
+            replicas=[m.index for m in targets],
+        )
+        logger.info(
+            "fleet reload -> %s (%d replica(s))", ckpt_dir, len(targets)
+        )
+        for member in targets:
+            member.inbox.put(
+                {"control": "reload", "ckpt_dir": str(ckpt_dir)}
+            )
+        want = {m.index for m in targets}
+
+        def valid_acks() -> Dict[int, Dict[str, Any]]:
+            # an ack counts for THIS reload only when it names this
+            # ckpt_dir (or names none — the worker-shutdown nack): a
+            # stale ack from a previous timed-out reload must not read
+            # as this one's success
+            return {
+                rid: a for rid, a in self._reload_acks.items()
+                if a.get("ckpt_dir") in (None, str(ckpt_dir))
+            }
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline and not want <= set(valid_acks()):
+            if self._serving:
+                # a dispatch loop is pumping the outbox; stealing from it
+                # here would drop serve messages — just wait for it to
+                # fill the acks
+                time.sleep(0.02)
+                continue
+            try:
+                msg = self._outbox.get(timeout=0.1)
+            except queue_mod.Empty:
+                continue
+            self._pump_idle(msg)
+        acks = valid_acks()
+        for rid in sorted(want - set(acks)):
+            acks[rid] = {
+                "ok": False, "error": f"no ack within {timeout_s}s",
+            }
+        if all(a.get("ok") for a in acks.values()):
+            # report field and registry counter move TOGETHER: both mean
+            # "a reload every live replica acknowledged" — a failed or
+            # timed-out broadcast must not read as a success anywhere
+            self.reloads += 1
+            get_registry().counter("fleet.reloads").inc()
+        return acks
+
+    def _pump_idle(self, msg) -> None:
+        """Minimal message handling for the BETWEEN-serves window (no
+        dispatch loop running): liveness, metrics, dumps and reload acks.
+        Request-scoped kinds are STASHED, not dropped — a serve() that
+        started on another thread while this pump held the outbox would
+        otherwise lose a 'done'/'token' and wait on its flight forever
+        (the serve loop re-dispatches the stash before reading the
+        outbox)."""
+        kind, rid = msg[0], msg[1]
+        member = next(
+            (m for m in self._members if m.index == rid and not m.dead),
+            None,
+        )
+        if member is not None:
+            member.last_msg_at = time.perf_counter()
+        if kind == "metrics":
+            self._metric_states[(rid, msg[2])] = msg[3]
+        elif kind == "dumps":
+            self._worker_dumps.extend(msg[2])
+        elif kind in ("reload_done", "reload_error"):
+            payload = dict(msg[2])
+            payload["ok"] = kind == "reload_done"
+            self._reload_acks[rid] = payload
+            get_tracer().event(
+                "fleet/reload_ack", cat="fleet", replica=rid,
+                ok=payload["ok"],
+            )
+        elif kind == "ready":
+            if member is not None:
+                member.ready = True  # a worker coming up mid-pump counts
+        elif kind != "hb":
+            self._stashed_msgs.append(msg)
+
     # -- serving -----------------------------------------------------------
 
     def serve(
-        self, requests: Sequence[Request]
+        self, requests: Sequence[Request], *, shutdown: bool = True
     ) -> tuple[List[CompletedRequest], FleetReport]:
         """Serve every request across the fleet; returns (results, report).
 
         Results preserve completion order.  Blocks until every request
-        reaches a terminal state (or the fleet drains), then shuts the
-        workers down gracefully.
+        reaches a terminal state (or the fleet drains), then — with
+        ``shutdown=True``, the default — shuts the workers down
+        gracefully.  ``shutdown=False`` keeps the worker processes alive
+        and idle, so a second ``serve`` call reuses them (no respawn, no
+        recompile) — the multi-batch shape :meth:`reload` slots between:
+        serve batch A, reload the fleet's weights, serve batch B on the
+        same processes.
         """
         trace = get_tracer()
         router_epoch_unix_s = trace.epoch_unix_s
         t_start = time.perf_counter()
-        self._members = [
-            self._spawn(i, self._dealt[i]) for i in range(self.replicas)
-        ]
+        if not self._members or all(m.dead for m in self._members):
+            self._members = [
+                self._spawn(i, self._dealt[i]) for i in range(self.replicas)
+            ]
+        self._serving = True
         flights: Dict[str, _Flight] = {}
         backlog: List[str] = []  # uids waiting for a live replica
         results: List[CompletedRequest] = []
@@ -898,6 +1203,16 @@ class FleetRouter:
                 # flight-recorder dumps the worker shipped before dying
                 # (injected death / quarantine / unhandled exception)
                 self._worker_dumps.extend(msg[2])
+            elif kind in ("reload_done", "reload_error"):
+                # live-reload acknowledgement: reload() (possibly on
+                # another thread) waits on these
+                payload = dict(msg[2])
+                payload["ok"] = kind == "reload_done"
+                self._reload_acks[rid] = payload
+                trace.event(
+                    "fleet/reload_ack", cat="fleet", replica=rid,
+                    ok=payload["ok"],
+                )
             elif kind == "ready" and member is not None:
                 member.ready = True
                 hs = msg[2]
@@ -982,175 +1297,139 @@ class FleetRouter:
         # timeout (the router's idle wait, not a device sync) — the
         # AST host-sync checker scans this region (sync budget 0) like
         # the trainer/scheduler loops; see analysis/regions.py.
-        while len(results) < len(flights):
-            live = [m for m in self._members if not m.dead]
-            if self._drain_event.is_set() and backlog:
-                # router-held work the drain will never admit: hand it to
-                # the control plane's resubmit path.  NOT one-shot — a
-                # replica dying DURING the drain redelivers its orphans
-                # into the backlog, and with every dispatch branch gated
-                # off by the drain nothing else would ever consume them
-                # (the loop would spin forever on len(results))
-                for uid in backlog:
-                    finalize(uid, {
-                        "tokens": [], "finish_reason": "preempted",
-                    })
-                backlog.clear()
-            if backlog and not live and not self._drain_event.is_set():
-                # no replica left and no restart budget: fail the
-                # stranded requests loudly instead of spinning forever
-                for uid in backlog:
-                    self.lost_requests += 1
-                    trace.event(
-                        "fleet/request_lost", cat="fleet", uid=uid,
-                        reason="no live replica",
-                        trace=flights[uid].trace_id,
-                    )
-                    finalize(uid, {
-                        "tokens": [], "finish_reason": "error",
-                        "error": "no live replica (restart budget spent)",
-                    })
-                backlog.clear()
-            if backlog and live and not self._drain_event.is_set():
-                held: List[str] = []
-                # only READY replicas take work: a request put on a
-                # still-spawning replica's inbox would sit unserved
-                # through its whole jax import + engine build while a
-                # live replica idles (holding at the router keeps the
-                # choice open until somebody can actually serve)
-                ready = [m for m in live if m.ready]
-                for uid in backlog:
-                    fl = flights[uid]
-                    if (
-                        fl.deadline_at is not None
-                        and time.perf_counter() > fl.deadline_at
-                    ):
-                        # expired while router-held (e.g. waiting out a
-                        # restart): same terminal state the worker would
-                        # give it, without burning a delivery
+        try:
+            while len(results) < len(flights):
+                live = [m for m in self._members if not m.dead]
+                if self._drain_event.is_set() and backlog:
+                    # router-held work the drain will never admit: hand it to
+                    # the control plane's resubmit path.  NOT one-shot — a
+                    # replica dying DURING the drain redelivers its orphans
+                    # into the backlog, and with every dispatch branch gated
+                    # off by the drain nothing else would ever consume them
+                    # (the loop would spin forever on len(results))
+                    for uid in backlog:
                         finalize(uid, {
-                            "tokens": [], "finish_reason": "deadline",
+                            "tokens": [], "finish_reason": "preempted",
                         })
-                        continue
-                    if not ready:
-                        held.append(uid)
-                        continue
-                    pool = [
-                        m for m in ready if m.index != fl.avoid
-                    ] or ready  # avoid the shedder unless it is all we have
-                    target = min(
-                        pool, key=lambda m: (len(m.outstanding), m.index)
-                    )
-                    # cap in-flight per replica at slots + a small ready
-                    # queue: enough to keep the worker's admission loop
-                    # fed, small enough that a death orphans (and redoes)
-                    # at most one batch's worth of work
-                    if len(target.outstanding) >= self.spec.batch_slots + 2:
-                        held.append(uid)  # every replica saturated: hold
-                        continue
-                    deliver(target, uid)
-                backlog[:] = held
-            if len(results) >= len(flights):
-                break
-            try:
-                process(self._outbox.get(timeout=0.05))
-            except queue_mod.Empty:
-                pass
-            now = time.perf_counter()
-            for member in list(self._members):
-                if member.dead:
-                    continue
-                code = member.proc.exitcode
-                if code is not None:
-                    if code != 0:
-                        handle_death(member, f"exit code {code}")
-                    else:
-                        # clean exit: give the pipe a grace period to
-                        # deliver trailing done/exit messages, then treat
-                        # a still-outstanding request set as a death
-                        if member.exit_seen_at is None:
-                            member.exit_seen_at = now
-                        if not member.outstanding and member.report is not None:
-                            retire(member)
-                        elif now - member.exit_seen_at > 2.0:
-                            if member.outstanding:
-                                handle_death(member, "clean exit mid-flight")
-                            else:
-                                retire(member)
-                elif (
-                    self.heartbeat_timeout_s is not None
-                    and member.last_msg_at is not None
-                    and member.outstanding
-                    and now - member.last_msg_at > self.heartbeat_timeout_s
-                ):
-                    member.proc.terminate()
-                    member.proc.join(timeout=5.0)
-                    handle_death(member, "heartbeat timeout")
-                elif (
-                    self.heartbeat_timeout_s is not None
-                    and not member.ready
-                    and member.last_msg_at is None
-                    and now - member.spawned_at
-                    > self.heartbeat_timeout_s + 180.0
-                ):
-                    # hung BEFORE the first message (stuck checkpoint
-                    # restore / jax init): no heartbeat ever arms the
-                    # staleness check above and no work is outstanding,
-                    # so without this bound the router would hold its
-                    # backlog for this replica forever.  The fixed +180 s
-                    # allowance covers a legitimate cold engine build.
-                    member.proc.terminate()
-                    member.proc.join(timeout=5.0)
-                    handle_death(member, "spawn hang")
-
-        # --- shutdown: close inboxes, join workers, collect reports ------
-        # A replica still mid-spawn (restarted near the end, engine not
-        # built) is terminated instead of joined: every result is already
-        # in, and waiting out a full jax import + engine compile would
-        # bill cold-start arithmetic to the serving wall (its
-        # replica_reports entry stays None).
-        for member in self._members:
-            if not member.dead:
+                    backlog.clear()
+                if backlog and not live and not self._drain_event.is_set():
+                    # no replica left and no restart budget: fail the
+                    # stranded requests loudly instead of spinning forever
+                    for uid in backlog:
+                        self.lost_requests += 1
+                        trace.event(
+                            "fleet/request_lost", cat="fleet", uid=uid,
+                            reason="no live replica",
+                            trace=flights[uid].trace_id,
+                        )
+                        finalize(uid, {
+                            "tokens": [], "finish_reason": "error",
+                            "error": "no live replica (restart budget spent)",
+                        })
+                    backlog.clear()
+                if backlog and live and not self._drain_event.is_set():
+                    held: List[str] = []
+                    # only READY replicas take work: a request put on a
+                    # still-spawning replica's inbox would sit unserved
+                    # through its whole jax import + engine build while a
+                    # live replica idles (holding at the router keeps the
+                    # choice open until somebody can actually serve)
+                    ready = [m for m in live if m.ready]
+                    for uid in backlog:
+                        fl = flights[uid]
+                        if (
+                            fl.deadline_at is not None
+                            and time.perf_counter() > fl.deadline_at
+                        ):
+                            # expired while router-held (e.g. waiting out a
+                            # restart): same terminal state the worker would
+                            # give it, without burning a delivery
+                            finalize(uid, {
+                                "tokens": [], "finish_reason": "deadline",
+                            })
+                            continue
+                        if not ready:
+                            held.append(uid)
+                            continue
+                        pool = [
+                            m for m in ready if m.index != fl.avoid
+                        ] or ready  # avoid the shedder unless it is all we have
+                        target = min(
+                            pool, key=lambda m: (len(m.outstanding), m.index)
+                        )
+                        # cap in-flight per replica at slots + a small ready
+                        # queue: enough to keep the worker's admission loop
+                        # fed, small enough that a death orphans (and redoes)
+                        # at most one batch's worth of work
+                        if len(target.outstanding) >= self.spec.batch_slots + 2:
+                            held.append(uid)  # every replica saturated: hold
+                            continue
+                        deliver(target, uid)
+                    backlog[:] = held
+                if len(results) >= len(flights):
+                    break
+                # messages a concurrent reload()'s idle pump read off the
+                # outbox before this loop started are re-dispatched first
+                while self._stashed_msgs:
+                    process(self._stashed_msgs.pop(0))
                 try:
-                    member.inbox.put(None)
-                except (ValueError, OSError):
+                    process(self._outbox.get(timeout=0.05))
+                except queue_mod.Empty:
                     pass
-        deadline = time.monotonic() + 60.0
-        for member in self._members:
-            if member.dead:
-                continue
-            if not member.ready:
-                member.proc.terminate()
-                member.proc.join(timeout=5.0)
-                continue
-            member.proc.join(timeout=max(0.5, deadline - time.monotonic()))
-            if member.proc.exitcode is None:
-                member.proc.terminate()
-                member.proc.join(timeout=5.0)
-        # Trailing messages: the dispatch loop exits the moment the last
-        # RESULT lands, but each worker's drain-time payload — its exit
-        # report, its FINAL metrics state (the one carrying the
-        # scheduler's end-of-run TTFT/TPOT histogram rollup) and any
-        # flight-recorder dumps — arrives after that, during shutdown.
-        # Dropping them here would leave the fleet merge with only the
-        # mid-run periodic ships.
-        while True:
-            try:
-                # short timeout, not get_nowait: the workers have exited,
-                # but the router-side queue thread may still be
-                # deserializing their final flush — one idle window
-                # bounds the wait
-                msg = self._outbox.get(timeout=0.25)
-            except queue_mod.Empty:
-                break
-            if msg[0] == "exit":
-                for member in self._members:
-                    if member.index == msg[1] and member.report is None:
-                        member.report = msg[2]
-            elif msg[0] == "metrics":
-                self._metric_states[(msg[1], msg[2])] = msg[3]
-            elif msg[0] == "dumps":
-                self._worker_dumps.extend(msg[2])
+                now = time.perf_counter()
+                for member in list(self._members):
+                    if member.dead:
+                        continue
+                    code = member.proc.exitcode
+                    if code is not None:
+                        if code != 0:
+                            handle_death(member, f"exit code {code}")
+                        else:
+                            # clean exit: give the pipe a grace period to
+                            # deliver trailing done/exit messages, then treat
+                            # a still-outstanding request set as a death
+                            if member.exit_seen_at is None:
+                                member.exit_seen_at = now
+                            if not member.outstanding and member.report is not None:
+                                retire(member)
+                            elif now - member.exit_seen_at > 2.0:
+                                if member.outstanding:
+                                    handle_death(member, "clean exit mid-flight")
+                                else:
+                                    retire(member)
+                    elif (
+                        self.heartbeat_timeout_s is not None
+                        and member.last_msg_at is not None
+                        and member.outstanding
+                        and now - member.last_msg_at > self.heartbeat_timeout_s
+                    ):
+                        member.proc.terminate()
+                        member.proc.join(timeout=5.0)
+                        handle_death(member, "heartbeat timeout")
+                    elif (
+                        self.heartbeat_timeout_s is not None
+                        and not member.ready
+                        and member.last_msg_at is None
+                        and now - member.spawned_at
+                        > self.heartbeat_timeout_s + 180.0
+                    ):
+                        # hung BEFORE the first message (stuck checkpoint
+                        # restore / jax init): no heartbeat ever arms the
+                        # staleness check above and no work is outstanding,
+                        # so without this bound the router would hold its
+                        # backlog for this replica forever.  The fixed +180 s
+                        # allowance covers a legitimate cold engine build.
+                        member.proc.terminate()
+                        member.proc.join(timeout=5.0)
+                        handle_death(member, "spawn hang")
+
+        finally:
+            # cleared even when the dispatch loop raises: a stuck
+            # True would make every later reload() sleep out its
+            # whole timeout waiting for a loop that no longer exists
+            self._serving = False
+        if shutdown:
+            self._shutdown_members()
 
         wall = time.perf_counter() - t_start
         ok = [r for r in results if r.finish_reason in ("eos", "length")]
@@ -1189,6 +1468,7 @@ class FleetRouter:
             restarts=self.restarts,
             replica_deaths=self.replica_deaths,
             redeliveries=self.redeliveries,
+            reloads=self.reloads,
             lost_requests=self.lost_requests,
             shed=self.shed_seen,
             drained=self._drain_event.is_set(),

@@ -359,6 +359,32 @@ class ContinuousBatchingScheduler:
             )
         self.spec_decoder = spec_decoder
         self._cancelled: set = set()
+        # live weight reload (serve/fleet.py): a callable applied at the
+        # next IDLE BARRIER — single attribute store/load, so setting it
+        # from another thread is safe
+        self._pending_reload: Optional[Callable[[], Any]] = None
+
+    def request_reload(self, apply_fn: Callable[[], Any]) -> None:
+        """Schedule a live weight reload; ``apply_fn`` runs at the next
+        idle barrier — no slot decoding, no prefill in flight — so every
+        request is served end-to-end by exactly ONE weight set, and a
+        request admitted after the reload decodes bit-identically to a
+        fresh engine built from the new weights.  While the reload is
+        pending, admission pauses (queued requests hold) and the active
+        requests drain to completion; it never interrupts a decode step,
+        let alone a token.  ``apply_fn`` must not raise (the fleet worker
+        wraps its restore and reports errors over the outbox); a raise
+        here is isolated, logged to the timeline, and serving continues
+        on the old weights.  A second request before the first applied
+        replaces it (last weight set wins)."""
+        self._pending_reload = apply_fn
+
+    @property
+    def has_pending_reload(self) -> bool:
+        """True when a requested reload has not applied yet — a worker
+        shutting down checks this to NACK the reload instead of leaving
+        the router waiting out its ack timeout."""
+        return self._pending_reload is not None
 
     def request_cancel(self, uid: str) -> None:
         """Mark ``uid`` for cancellation; it finishes ``"cancelled"`` at
@@ -849,6 +875,28 @@ class ContinuousBatchingScheduler:
                     while pending:
                         fail_request(pending.popleft(), None, reason="preempted")
 
+                # live weight reload: applied ONLY at the idle barrier —
+                # nothing decoding, nothing prefilling — so the swap is
+                # between steps by construction and every request sees one
+                # weight set end to end.  While pending, the admission
+                # block below is gated off (active work drains, queued
+                # work holds for the new weights).
+                if (
+                    self._pending_reload is not None
+                    and not active
+                    and not prefilling
+                ):
+                    apply_reload = self._pending_reload
+                    self._pending_reload = None
+                    try:
+                        with trace.span("serve/reload_barrier"):
+                            apply_reload()
+                    except Exception as exc:  # noqa: BLE001 — old weights keep serving
+                        trace.event(
+                            "serve/reload_failed", cat="serve",
+                            error=f"{type(exc).__name__}: {exc}",
+                        )
+
                 # deadline / cancellation sweep over in-flight work (queued
                 # requests are checked at their admission attempt below)
                 if self._cancelled or any(
@@ -873,7 +921,13 @@ class ContinuousBatchingScheduler:
                 # Paged engines additionally gate on free PAGES: a request that
                 # could strand mid-decode is left queued (backpressure) until
                 # completions free its reservation.
-                while pending and not draining and free:
+                while (
+                    pending and not draining and free
+                    # reload pending: hold admission so the active set
+                    # drains to the idle barrier (queued requests are
+                    # served by the NEW weights after the swap)
+                    and self._pending_reload is None
+                ):
                     req = pending[0]
                     budget = budget_of(req)
                     m = meta[req.uid]

@@ -195,6 +195,13 @@ class TrainerConfig:
     # under the workload runner, the signal a supervisor restarts on).
     # None = auto: enabled exactly when a checkpoint_dir is configured.
     preemption_guard: Optional[bool] = None
+    # Preemption GRACE WINDOW (seconds from SIGTERM to the platform's
+    # SIGKILL).  When set, the emergency-checkpoint path plumbs the
+    # window's remainder into the storage retry layer as a hard deadline
+    # (retry_call(deadline_s=...)) so backoff can never sleep past the
+    # kill — a checkpoint that retries itself into the SIGKILL saves
+    # nothing.  None = unknown window, retries stay wall-clock-unbounded.
+    preemption_grace_s: Optional[float] = None
     # Host-side anomaly detection: abort (AnomalyError) after this many
     # CONSECUTIVE non-finite loss/grad-norm steps; isolated blips are
     # counted and tolerated.  None = off.  Costs one device sync per step;
@@ -320,7 +327,11 @@ class Trainer:
         use_guard = cfg.preemption_guard
         if use_guard is None:
             use_guard = self.checkpointer is not None
-        guard = PreemptionGuard().install() if use_guard else None
+        guard = (
+            PreemptionGuard(grace_s=cfg.preemption_grace_s).install()
+            if use_guard
+            else None
+        )
         if plan and guard is None and any(
             s.kind == "preempt" for s in plan.specs
         ):
@@ -395,11 +406,18 @@ class Trainer:
                     # The live (finite, thanks to the in-jit guard) state is
                     # the restore template for the rollback pass.
                     state = getattr(exc, "state", state)
+                    # restore-eligibility is the VERIFIED step: rolling
+                    # back into a corrupt generation would trade a
+                    # diverging run for a bricked one
+                    rollback_to = (
+                        self.checkpointer.latest_verified_step()
+                        if self.checkpointer is not None
+                        else None
+                    )
                     can_roll = (
                         cfg.anomaly_rollback
                         and cfg.resume
-                        and self.checkpointer is not None
-                        and self.checkpointer.latest_step() is not None
+                        and rollback_to is not None
                         and rollbacks < cfg.anomaly_max_rollbacks
                     )
                     if not can_roll:
@@ -409,12 +427,12 @@ class Trainer:
                     get_tracer().event(
                         "resilience/rollback", cat="resilience",
                         step=exc.step,
-                        to_step=self.checkpointer.latest_step(),
+                        to_step=rollback_to,
                     )
                     logger.warning(
                         "anomaly abort at step %s — rolling back to "
                         "checkpoint step %s (%d/%d rollbacks)",
-                        exc.step, self.checkpointer.latest_step(),
+                        exc.step, rollback_to,
                         rollbacks, cfg.anomaly_max_rollbacks,
                     )
                 finally:
@@ -438,7 +456,7 @@ class Trainer:
             if guard is not None:
                 guard.uninstall()
 
-    def _emergency_stop(self, step: int, state, watchdog) -> None:
+    def _emergency_stop(self, step: int, state, watchdog, guard=None) -> None:
         """Preemption noticed at a step boundary: synchronous emergency
         checkpoint, then PreemptionError (→ exit 75 under the runner)."""
         if watchdog is not None:
@@ -452,12 +470,24 @@ class Trainer:
             )
             # save() copies device→host synchronously; wait() drains the
             # background write.  Both must land BEFORE the resumable exit:
-            # the grace window is short and the checkpoint IS the recovery.
+            # the grace window is short and the checkpoint IS the recovery
+            # — so the window's REMAINDER (re-read before each phase; save
+            # may have consumed most of it) deadline-bounds the retry
+            # backoff inside both (retry_call(deadline_s=...)).
             with get_tracer().span(
                 "train/emergency_checkpoint", cat="resilience", step=step
             ):
-                self.checkpointer.save(step, state)
-                self.checkpointer.wait()
+                self.checkpointer.save(
+                    step, state,
+                    deadline_s=(
+                        guard.remaining_grace() if guard is not None else None
+                    ),
+                )
+                self.checkpointer.wait(
+                    deadline_s=(
+                        guard.remaining_grace() if guard is not None else None
+                    ),
+                )
             logger.warning("emergency checkpoint at step %d complete", step)
         raise PreemptionError(
             f"preempted at step {step} (emergency checkpoint "
@@ -592,7 +622,9 @@ class Trainer:
                     if plan:
                         plan.maybe_preempt(true_step, guard)
                     if guard.preempted():
-                        self._emergency_stop(true_step, state, watchdog)
+                        self._emergency_stop(
+                            true_step, state, watchdog, guard=guard
+                        )
             if profile_active:
                 # Run shorter than the window: close the trace on step work
                 # only — eval/checkpoint/TB below must not pollute it.

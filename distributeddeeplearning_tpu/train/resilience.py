@@ -73,10 +73,27 @@ class PreemptionGuard:
 
     A second SIGINT falls through to the previous handler (double Ctrl-C
     still kills an interactive run immediately).
+
+    ``grace_s`` is the preemption GRACE WINDOW: how long after the signal
+    the platform waits before SIGKILL.  The guard stamps the signal's
+    arrival time, and :meth:`remaining_grace` reports what is left of the
+    window — the emergency-checkpoint path plumbs that remainder into the
+    storage retry layer (``retry_call(deadline_s=...)``) so backoff can
+    never sleep past the kill.  ``None`` = unknown window (no deadline
+    plumbed; the old wall-clock-unbounded behavior).
     """
 
-    def __init__(self, signals: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT)):
+    def __init__(
+        self,
+        signals: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT),
+        *,
+        grace_s: Optional[float] = None,
+    ):
+        if grace_s is not None and grace_s <= 0:
+            raise ValueError(f"grace_s must be > 0, got {grace_s}")
         self.signals = signals
+        self.grace_s = grace_s
+        self.triggered_at: Optional[float] = None
         self._flag = threading.Event()
         self.reason: Optional[str] = None
         self._previous: dict = {}
@@ -117,6 +134,10 @@ class PreemptionGuard:
             else:
                 raise KeyboardInterrupt
         self.reason = f"signal {signal.Signals(signum).name}"
+        if self.triggered_at is None:
+            # arm the grace clock at the FIRST signal (time.monotonic is
+            # async-signal-safe: a C call, no Python locks)
+            self.triggered_at = time.monotonic()
         self._flag.set()
         get_tracer().event(
             "resilience/preemption_signal", cat="resilience",
@@ -126,6 +147,8 @@ class PreemptionGuard:
     def trigger(self, reason: str = "triggered") -> None:
         """Programmatic preemption (fault injection, tests)."""
         self.reason = reason
+        if self.triggered_at is None:
+            self.triggered_at = time.monotonic()
         self._flag.set()
         get_tracer().event(
             "resilience/preemption_signal", cat="resilience", reason=reason
@@ -133,6 +156,14 @@ class PreemptionGuard:
 
     def preempted(self) -> bool:
         return self._flag.is_set()
+
+    def remaining_grace(self) -> Optional[float]:
+        """Seconds left of the preemption grace window, floored at 0 —
+        the deadline the emergency checkpoint's retries must fit inside.
+        ``None`` when no window is configured or no signal has arrived."""
+        if self.grace_s is None or self.triggered_at is None:
+            return None
+        return max(0.0, self.grace_s - (time.monotonic() - self.triggered_at))
 
     def __enter__(self) -> "PreemptionGuard":
         return self.install()

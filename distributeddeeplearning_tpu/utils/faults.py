@@ -55,6 +55,22 @@ step** counter, 1-based, per worker process):
                  overload-shedding path; the request finishes ``"shed"``
                  and the fleet router redelivers it elsewhere.
 
+Checkpoint durability kinds (consumed by ``train/checkpoint.py`` — their
+``@N`` is **generation-opportunity**-keyed, like ``io_error``'s, because
+storage finalization has no train-step context):
+
+- ``ckpt_corrupt`` corrupt the Nth FINALIZED checkpoint generation right
+                 after its manifest lands — ``:mode=`` picks how: ``flip``
+                 (one byte of the largest data file), ``truncate`` (cut it
+                 in half), ``unlink`` (delete it), ``manifest`` (delete
+                 the manifest itself).  The verified-restore path must
+                 fall back to the newest older generation that still
+                 verifies;
+- ``ckpt_torn``  kill the writer mid-generation: the Nth save finalize
+                 truncates a data file and never writes its manifest —
+                 the generation is by-construction incomplete and must
+                 never be restore-eligible.
+
 The serve step-keyed kinds use **at-or-after** matching (first decode
 step ``>= N``): decode steps are contiguous per worker, but ``decode_nan``
 must wait for an eligible victim, and at-or-after keeps the whole family
@@ -86,6 +102,7 @@ ENV_VAR = "DDLT_FAULTS"
 KINDS = (
     "nan_loss", "data_stall", "data_death", "preempt", "io_error",
     "replica_death", "decode_nan", "decode_stall", "reject_admit",
+    "ckpt_corrupt", "ckpt_torn",
 )
 
 #: kinds the serving stack consumes — the fleet supervisor DEALS these
@@ -388,6 +405,41 @@ class FaultPlan:
                     spec.fired = True
                     self._record(spec, spec.step, site)
                     raise InjectedIOError(f"injected io_error ({site})")
+
+    # -- hook: checkpoint durability (train/checkpoint.py) ---------------
+
+    def _take_nth_opportunity(
+        self, kind: str, site: str
+    ) -> Optional[FaultSpec]:
+        """Consume a one-shot ``kind`` fault at its Nth opportunity (the
+        per-spec call counter — the same keying ``io_error@N`` uses,
+        because storage paths have no train-step context)."""
+        for spec in self.specs:
+            if spec.kind != kind or spec.fired:
+                continue
+            n = self._io_opportunities.get(id(spec), 0) + 1
+            self._io_opportunities[id(spec)] = n
+            if n >= (spec.step or 1):
+                spec.fired = True
+                self._record(spec, spec.step, site)
+                return spec
+        return None
+
+    def take_ckpt_corrupt(self) -> Optional[Dict[str, Any]]:
+        """``ckpt_corrupt``: options dict (``mode`` etc.) when THIS
+        checkpoint-generation finalize must corrupt the generation it
+        just committed, else None.  Opportunity-keyed: ``@N`` fires at
+        the Nth finalized generation of the process."""
+        spec = self._take_nth_opportunity("ckpt_corrupt", "ckpt_corrupt")
+        return dict(spec.options) if spec is not None else None
+
+    def take_ckpt_torn(self) -> bool:
+        """``ckpt_torn``: True when THIS save finalize must tear the
+        generation (truncate a data file, never write the manifest) —
+        the writer-died-mid-generation failure mode."""
+        return (
+            self._take_nth_opportunity("ckpt_torn", "ckpt_torn") is not None
+        )
 
     # -- reporting -------------------------------------------------------
 

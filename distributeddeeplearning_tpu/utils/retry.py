@@ -13,10 +13,16 @@ policy, used by every caller that talks to the outside world
 - **exponential with full jitter** (AWS architecture-blog recipe): the
   attempt-``i`` sleep is drawn uniformly from ``[0, min(max_delay,
   base_delay * 2**i)]``.  Full jitter decorrelates the retry herd a
-  preemption wave would otherwise synchronize across hosts.
+  preemption wave would otherwise synchronize across hosts;
+- **deadline-aware** (``deadline_s``): some callers retry inside a hard
+  wall-clock budget — the emergency-checkpoint path runs inside the
+  preemption grace window, where a backoff schedule that outlives the
+  window converts a savable run into a killed one.  Once the budget is
+  spent the last failure propagates immediately, and a sleep is clamped
+  so it can never overshoot the window.
 
-``sleep``/``rng`` are injectable so tests assert the bound without
-sleeping.
+``sleep``/``rng``/``clock`` are injectable so tests assert the bounds
+without sleeping.
 
 Every retry and every give-up is ALSO counted in the process metrics
 registry (``retry.attempts.<label>`` / ``retry.giveups.<label>``, label =
@@ -80,6 +86,8 @@ def retry_call(
     rng: Optional[random.Random] = None,
     description: str = "",
     on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    deadline_s: Optional[float] = None,
+    clock: Callable[[], float] = time.monotonic,
     **kwargs,
 ):
     """Call ``fn(*args, **kwargs)``; on ``retry_on`` retry up to ``retries``
@@ -87,9 +95,20 @@ def retry_call(
 
     ``description`` names the operation in the warning log lines;
     ``on_retry(attempt, exc)`` observes each retry (metrics hooks, tests).
+
+    ``deadline_s`` bounds the WHOLE retry sequence on the wall clock
+    (measured by ``clock`` from the first attempt's start): once the
+    budget is spent, the current failure re-raises instead of sleeping —
+    and no single sleep may overshoot the remaining window.  This is how
+    the emergency-checkpoint path keeps its backoff inside the preemption
+    grace window (a retry schedule that sleeps past the SIGKILL saves
+    nothing).  ``None`` (the default) keeps the unbounded behavior.
     """
     if retries < 0:
         raise ValueError(f"retries must be >= 0, got {retries}")
+    if deadline_s is not None and deadline_s < 0:
+        raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
+    t0 = clock()
     delays = backoff_delays(
         retries, base_delay=base_delay, max_delay=max_delay, rng=rng
     )
@@ -106,6 +125,20 @@ def retry_call(
                 _count("giveups", label)
                 raise
             delay = next(delays)
+            if deadline_s is not None:
+                remaining = deadline_s - (clock() - t0)
+                if remaining <= 0.0:
+                    # budget spent: re-raising NOW is the only move that
+                    # can still leave grace for whatever comes after
+                    _count("giveups", label)
+                    logger.warning(
+                        "%s failed (%s); retry deadline %.2fs exhausted — "
+                        "giving up without sleeping",
+                        description or getattr(fn, "__name__", "operation"),
+                        exc, deadline_s,
+                    )
+                    raise
+                delay = min(delay, remaining)
             attempt += 1
             _count("attempts", label)
             logger.warning(

@@ -1,11 +1,14 @@
 """Fixture injection site: only ``covered_kind``'s hook is ever called.
 
-``fire_orphan`` appears below in a comment and a string — neither is a
-call, so the AST pass must still report ``orphan_kind`` as uncovered.
+``fire_orphan`` and ``take_ckpt_corrupt`` appear below in comments and
+strings — neither is a call, so the AST pass must still report
+``orphan_kind`` AND ``ckpt_corrupt`` as uncovered.
 """
 
 # plan.fire_orphan() — a comment is not an injection site
+# plan.take_ckpt_corrupt() — neither is this one
 DOC = "plan.fire_orphan() in a string is not an injection site either"
+CKPT_DOC = "plan.take_ckpt_corrupt() in a string does not count"
 
 
 def run(plan):

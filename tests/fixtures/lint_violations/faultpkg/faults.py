@@ -1,6 +1,12 @@
-"""Fixture faults module: two declared kinds, one injection hook each."""
+"""Fixture faults module: declared kinds with one injection hook each.
 
-KINDS = ("covered_kind", "orphan_kind")
+``covered_kind`` is wired in ``consumer.py``; ``orphan_kind`` and the
+checkpoint-durability kind ``ckpt_corrupt`` are declared (hooks exist on
+``FaultPlan``) but never CALLED anywhere — the coverage pass must report
+both as uncovered.
+"""
+
+KINDS = ("covered_kind", "orphan_kind", "ckpt_corrupt")
 
 
 class FaultPlan:
@@ -9,3 +15,6 @@ class FaultPlan:
 
     def fire_orphan(self):
         return True
+
+    def take_ckpt_corrupt(self):
+        return {"mode": "flip"}

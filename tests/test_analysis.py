@@ -168,6 +168,7 @@ class TestFaultCoverage:
     HOOKS = {
         "covered_kind": ("fire_covered",),
         "orphan_kind": ("fire_orphan",),
+        "ckpt_corrupt": ("take_ckpt_corrupt",),
     }
 
     def test_orphan_kind_is_caught_with_file_line(self):
@@ -177,19 +178,36 @@ class TestFaultCoverage:
             package_root=str(FIXTURES / "faultpkg"),
             kind_hooks=self.HOOKS,
         )
-        assert len(findings) == 1, format_findings(findings)
-        f = findings[0]
+        # orphan_kind and the checkpoint kind below are both uncovered
+        orphans = [f for f in findings if "orphan_kind" in f.message]
+        assert len(orphans) == 1, format_findings(findings)
+        f = orphans[0]
         assert f.checker == "fault-coverage"
-        assert "orphan_kind" in f.message
         assert f.path.endswith("faults.py")
         assert f.line == _line_of(faults, "KINDS = ")
+
+    def test_orphan_checkpoint_fault_kind_is_caught(self):
+        """A checkpoint-durability kind whose injection hook exists but is
+        never CALLED (comment/string decoys planted in the fixture) must
+        be reported — a renamed ``take_ckpt_corrupt`` call-site would
+        silently drop corruption chaos from every bench."""
+        findings = check_fault_coverage(
+            faults_path=str(FIXTURES / "faultpkg" / "faults.py"),
+            package_root=str(FIXTURES / "faultpkg"),
+            kind_hooks=self.HOOKS,
+        )
+        ckpt = [f for f in findings if "ckpt_corrupt" in f.message]
+        assert len(ckpt) == 1, format_findings(findings)
+        assert "no injection call-site" in ckpt[0].message
+        assert "take_ckpt_corrupt" in ckpt[0].message
 
     def test_renamed_hook_is_caught(self):
         findings = check_fault_coverage(
             faults_path=str(FIXTURES / "faultpkg" / "faults.py"),
             package_root=str(FIXTURES / "faultpkg"),
             kind_hooks={"covered_kind": ("fire_covered_RENAMED",),
-                        "orphan_kind": ("fire_orphan",)},
+                        "orphan_kind": ("fire_orphan",),
+                        "ckpt_corrupt": ("take_ckpt_corrupt",)},
         )
         assert any(
             "not a FaultPlan method" in f.message for f in findings

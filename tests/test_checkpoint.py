@@ -1,5 +1,10 @@
 """Sharded checkpoint/resume semantics (the protocol the reference only had
-in dead code — PyTorch_hvd:62-72,133-144)."""
+in dead code — PyTorch_hvd:62-72,133-144), plus the durable-state layer:
+verified manifests, corruption-tolerant fallback restore, the params-only
+item split, torn-writer semantics and the async-save/eviction interleave."""
+
+import dataclasses
+import json
 
 import jax
 import jax.numpy as jnp
@@ -9,13 +14,57 @@ import pytest
 
 from distributeddeeplearning_tpu.data.synthetic import synthetic_batch
 from distributeddeeplearning_tpu.models import get_model
+from distributeddeeplearning_tpu.obs.recorder import get_recorder
+from distributeddeeplearning_tpu.obs.registry import get_registry
 from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh, shard_batch
-from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
+from distributeddeeplearning_tpu.train.checkpoint import (
+    MANIFEST_NAME,
+    CheckpointCorruptionError,
+    Checkpointer,
+    corrupt_generation,
+    latest_verified_step_in_dir,
+    load_manifest,
+)
+from distributeddeeplearning_tpu.train.resilience import PreemptionError
 from distributeddeeplearning_tpu.train.state import create_train_state, sgd_momentum
 from distributeddeeplearning_tpu.train.step import build_train_step
+from distributeddeeplearning_tpu.utils import faults as faults_mod
 
 IMG = (24, 24, 3)
 NCLS = 7
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    """Tests install explicit plans; none may leak into the next test."""
+    yield
+    faults_mod.install_plan("")
+
+
+@dataclasses.dataclass
+class MiniState:
+    """Minimal TrainState stand-in: the Checkpointer touches exactly
+    these fields (checkpoint-layer tests need no optimizer)."""
+
+    step: object
+    params: object
+    opt_state: object
+    batch_stats: object
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+
+def mini_state(step: int = 0, scale: float = 1.0) -> MiniState:
+    return MiniState(
+        step=jnp.int32(step),
+        params={
+            "w": scale * jnp.arange(4096, dtype=jnp.float32).reshape(64, 64),
+            "b": scale * jnp.ones(64, jnp.float32),
+        },
+        opt_state={"m": jnp.zeros(64, jnp.float32)},
+        batch_stats={},
+    )
 
 
 @pytest.fixture(scope="module")
@@ -106,3 +155,488 @@ def test_resume_training_continues_identically(setup, tmp_path):
         jax.tree_util.tree_leaves(resumed.params),
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# durable state: manifests, verified restore, fallback, torn writers
+# --------------------------------------------------------------------------
+
+
+def test_manifest_commits_only_after_wait(tmp_path):
+    """A manifest may only ever certify data that has fully landed: with
+    one async save in flight the generation has no manifest (not
+    restore-eligible to a fresh reader); wait() commits it."""
+    ckpt = Checkpointer(str(tmp_path / "d"))
+    try:
+        ckpt.save(1, mini_state(1))
+        # single in-flight async save: its manifest is still pending
+        assert load_manifest(tmp_path / "d" / "1") is None
+        # a FRESH reader (serve startup racing the writer) must not
+        # trust the unfinalized generation
+        reader = Checkpointer(str(tmp_path / "d"))
+        try:
+            assert reader.latest_verified_step() is None
+        finally:
+            reader._mgr.close()  # close() would commit nothing but waits
+        ckpt.wait()
+        manifest = load_manifest(tmp_path / "d" / "1")
+        assert manifest is not None and manifest["step"] == 1
+        assert ckpt.latest_verified_step() == 1
+        assert latest_verified_step_in_dir(tmp_path / "d") == 1
+    finally:
+        ckpt.close()
+
+
+def test_params_only_item_layout_and_restore(tmp_path):
+    """Generations carry a separate ``params`` item, and restore_params
+    reads it back exactly (the serve-startup read no longer pays for the
+    optimizer state's bytes)."""
+    ckpt = Checkpointer(str(tmp_path / "d"))
+    try:
+        st = mini_state(3, scale=2.5)
+        ckpt.save(3, st)
+        ckpt.wait()
+        assert (tmp_path / "d" / "3" / "params").is_dir()
+        assert (tmp_path / "d" / "3" / "state").is_dir()
+        params, step = ckpt.restore_params()
+        assert step == 3
+        for k in ("w", "b"):
+            np.testing.assert_array_equal(
+                np.asarray(params[k]), np.asarray(st.params[k])
+            )
+    finally:
+        ckpt.close()
+
+
+@pytest.mark.parametrize("mode", ["flip", "truncate", "unlink", "manifest"])
+def test_corrupt_latest_falls_back_to_verified(tmp_path, mode):
+    """One corrupt latest generation costs ONE generation of progress:
+    restore walks back to the newest verified one, bumps the
+    ckpt.verify_failures counter and leaves a flight-recorder dump
+    naming the failed generation."""
+    reg = get_registry()
+    rec = get_recorder()
+    rec.drain_dumps()
+    before = reg.counter("ckpt.verify_failures").value
+    ckpt = Checkpointer(str(tmp_path / "d"))
+    try:
+        ckpt.save(1, mini_state(1, scale=1.0))
+        ckpt.save(2, mini_state(2, scale=7.0))
+        ckpt.wait()
+        corrupt_generation(tmp_path / "d" / "2", mode)
+        state, step = ckpt.restore(mini_state())
+        assert step == 1
+        assert int(np.asarray(state.step)) == 1
+        np.testing.assert_array_equal(
+            np.asarray(state.params["b"]), np.ones(64, np.float32)
+        )
+        # the fallback is observable: counter + dump name the generation
+        assert reg.counter("ckpt.verify_failures").value > before
+        dumps = rec.drain_dumps()
+        assert any(
+            d["reason"] == "ckpt_verify_failed" and d.get("generation") == 2
+            for d in dumps
+        ), [d.get("reason") for d in dumps]
+        # restore_params takes the same fallback
+        params, pstep = ckpt.restore_params()
+        assert pstep == 1
+        np.testing.assert_array_equal(
+            np.asarray(params["b"]), np.ones(64, np.float32)
+        )
+    finally:
+        ckpt.close()
+
+
+def test_latest_verified_step_skips_corrupt_manifest(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "d"))
+    try:
+        ckpt.save(1, mini_state(1))
+        ckpt.save(2, mini_state(2))
+        ckpt.wait()
+        assert ckpt.latest_verified_step() == 2
+        corrupt_generation(tmp_path / "d" / "2", "manifest")
+        assert ckpt.latest_verified_step() == 1
+        assert latest_verified_step_in_dir(tmp_path / "d") == 1
+    finally:
+        ckpt.close()
+
+
+def test_ckpt_torn_fault_leaves_generation_ineligible(tmp_path):
+    """ckpt_torn models the writer dying mid-generation: data truncated,
+    manifest never written — restore must treat the generation as
+    incomplete and resume from the previous one."""
+    faults_mod.install_plan("ckpt_torn@2")
+    ckpt = Checkpointer(str(tmp_path / "d"))
+    try:
+        ckpt.save(1, mini_state(1))
+        ckpt.save(2, mini_state(2))
+        ckpt.wait()
+        assert load_manifest(tmp_path / "d" / "2") is None
+        assert ckpt.latest_verified_step() == 1
+        state, step = ckpt.restore(mini_state())
+        assert step == 1 and int(np.asarray(state.step)) == 1
+        plan = faults_mod.get_plan()
+        assert [e.kind for e in plan.events] == ["ckpt_torn"]
+    finally:
+        ckpt.close()
+
+
+def test_ckpt_corrupt_fault_fires_at_nth_generation(tmp_path):
+    """The @N trigger is generation-opportunity keyed: @2 corrupts the
+    SECOND finalized generation (the latest of this run)."""
+    faults_mod.install_plan("ckpt_corrupt@2:mode=flip")
+    ckpt = Checkpointer(str(tmp_path / "d"))
+    try:
+        ckpt.save(1, mini_state(1))
+        ckpt.save(2, mini_state(2))
+        ckpt.wait()
+        _, step = ckpt.restore(mini_state())
+        assert step == 1  # gen 2 was corrupted after finalize
+        plan = faults_mod.get_plan()
+        assert [e.kind for e in plan.events] == ["ckpt_corrupt"]
+    finally:
+        ckpt.close()
+
+
+def test_every_generation_corrupt_raises_loudly(tmp_path):
+    """An all-corrupt store must FAIL, not silently restart from scratch
+    (and not restart-loop: CheckpointCorruptionError is deliberately not
+    a RestartableError)."""
+    from distributeddeeplearning_tpu.train.resilience import RestartableError
+
+    ckpt = Checkpointer(str(tmp_path / "d"))
+    try:
+        ckpt.save(1, mini_state(1))
+        ckpt.wait()
+        corrupt_generation(tmp_path / "d" / "1", "flip")
+        with pytest.raises(CheckpointCorruptionError):
+            ckpt.restore(mini_state())
+        assert not issubclass(CheckpointCorruptionError, RestartableError)
+    finally:
+        ckpt.close()
+
+
+def test_legacy_manifestless_dir_still_restores(tmp_path):
+    """Pre-durability checkpoints (single ``default`` item, no manifest
+    anywhere, no marker) keep restoring through the legacy full-read
+    path — both restore() and restore_params()."""
+    import orbax.checkpoint as ocp
+
+    d = tmp_path / "legacy"
+    mgr = ocp.CheckpointManager(
+        str(d), options=ocp.CheckpointManagerOptions(create=True)
+    )
+    st = mini_state(5, scale=3.0)
+    mgr.save(
+        5,
+        args=ocp.args.StandardSave({
+            "step": st.step, "params": st.params,
+            "opt_state": st.opt_state, "batch_stats": st.batch_stats,
+        }),
+    )
+    mgr.wait_until_finished()
+    mgr.close()
+    ckpt = Checkpointer(str(d))
+    try:
+        assert ckpt.latest_verified_step() == 5  # legacy trust + warning
+        state, step = ckpt.restore(mini_state())
+        assert step == 5
+        np.testing.assert_array_equal(
+            np.asarray(state.params["w"]), np.asarray(st.params["w"])
+        )
+        params, pstep = ckpt.restore_params()
+        assert pstep == 5
+        np.testing.assert_array_equal(
+            np.asarray(params["b"]), np.asarray(st.params["b"])
+        )
+    finally:
+        ckpt.close()
+
+
+# --------------------------------------------------------------------------
+# async-save / eviction interleaving (satellite)
+# --------------------------------------------------------------------------
+
+
+def test_eviction_racing_pending_async_save(tmp_path):
+    """max_to_keep eviction can delete a generation whose manifest is
+    still pending: the pending entry is dropped (no crash, no manifest
+    for a ghost dir) and every SURVIVING generation ends verified."""
+    ckpt = Checkpointer(str(tmp_path / "d"), max_to_keep=2)
+    try:
+        for i in range(1, 6):
+            ckpt.save(i, mini_state(i))
+        ckpt.wait()
+        kept = sorted(
+            int(p.name) for p in (tmp_path / "d").iterdir()
+            if p.name.isdigit()
+        )
+        assert kept == [4, 5]
+        for s in kept:
+            assert load_manifest(tmp_path / "d" / str(s)) is not None
+        assert ckpt.latest_verified_step() == 5
+        # no orphaned pending entries left behind
+        assert ckpt._pending_manifests == {}
+    finally:
+        ckpt.close()
+
+
+def test_wait_before_restore_contract_same_process(tmp_path):
+    """Within one process the writer must wait() before its own restore:
+    the freshly-saved generation becomes eligible only after the drain
+    (before it, restore sees the older verified generation)."""
+    ckpt = Checkpointer(str(tmp_path / "d"))
+    try:
+        ckpt.save(1, mini_state(1))
+        ckpt.wait()
+        ckpt.save(2, mini_state(2))
+        # gen 2's manifest is pending: restore must land on gen 1
+        _, step = ckpt.restore(mini_state())
+        assert step == 1
+        ckpt.wait()
+        _, step = ckpt.restore(mini_state())
+        assert step == 2
+    finally:
+        ckpt.close()
+
+
+def test_close_on_preemption_error_path_commits_manifest(tmp_path):
+    """The PreemptionError unwind: emergency save -> raise -> close() in
+    the finally.  close() drains AND commits the manifest, so the
+    restart actually gets the emergency generation."""
+    ckpt = Checkpointer(str(tmp_path / "d"))
+    with pytest.raises(PreemptionError):
+        try:
+            ckpt.save(7, mini_state(7))
+            raise PreemptionError("preempted at step 7", step=7)
+        finally:
+            ckpt.close()
+    reader = Checkpointer(str(tmp_path / "d"))
+    try:
+        assert reader.latest_verified_step() == 7
+        state, step = reader.restore(mini_state())
+        assert step == 7 and int(np.asarray(state.step)) == 7
+    finally:
+        reader.close()
+
+
+def test_manifest_is_atomic_json(tmp_path):
+    """The manifest itself is written tmp+rename: no .tmp residue, valid
+    JSON, and it names every leaf of both items with shape/dtype/crc."""
+    ckpt = Checkpointer(str(tmp_path / "d"))
+    try:
+        ckpt.save(1, mini_state(1))
+        ckpt.wait()
+        step_dir = tmp_path / "d" / "1"
+        assert not list(step_dir.glob("*.tmp"))
+        manifest = json.loads((step_dir / MANIFEST_NAME).read_text())
+        leaves = manifest["leaves"]
+        assert any(k.startswith("params/") for k in leaves)
+        assert any(k.startswith("state/") for k in leaves)
+        for entry in leaves.values():
+            assert set(entry) == {"shape", "dtype", "crc32"}
+    finally:
+        ckpt.close()
+
+
+def test_async_manifest_checksum_is_donation_safe(setup, tmp_path):
+    """The background checksum must hash a PRIVATE host snapshot: the
+    donated train step reuses the state buffers in place right after
+    save() returns, so hashing a zero-copy view (what device_get hands
+    back on CPU) would checksum clobbered bytes and poison every
+    generation's manifest — caught live by ``bench.py --ckpt-faults``."""
+    reg = get_registry()
+    before = reg.counter("ckpt.verify_failures").value
+    mesh, mk_state, step, batch = setup
+    state = mk_state()
+    ckpt = Checkpointer(str(tmp_path / "d"))
+    try:
+        for i in range(1, 4):
+            state, _ = step(state, batch)
+            ckpt.save(i, state)  # the next step donates state's buffers
+        ckpt.wait()
+        restored, s = ckpt.restore(mk_state())
+        assert s == 3  # the LATEST generation verified — no fallback
+        assert reg.counter("ckpt.verify_failures").value == before
+        # ... and a generation whose async write RACED later donated
+        # steps still holds its own step's bytes: drop gen 3, restore
+        # gen 2, whose saved step value must be exactly 2 (the aliasing
+        # bug stored a LATER step's clobbered buffer here)
+        corrupt_generation(tmp_path / "d" / "3", "manifest")
+        restored2, s2 = ckpt.restore(mk_state())
+        assert s2 == 2
+        assert int(np.asarray(restored2.step)) == 2
+    finally:
+        ckpt.close()
+
+
+# --------------------------------------------------------------------------
+# CKPT_DURABLE artifact schema: accept / reject
+# --------------------------------------------------------------------------
+
+
+def _minimal_ckpt_durable_payload():
+    return {
+        "metric": "ckpt_durable_verify_overhead_pct",
+        "value": 1.0, "unit": "%", "bench_revision": 16,
+        "platform": "cpu", "virtual_pod": True,
+        "faults_spec": "ckpt_corrupt@4:mode=flip",
+        "resume": {
+            "expected_step": 6, "resumed_step": 6, "exact": True,
+            "verify_failures_observed": 1,
+        },
+        "corrupt_modes": {
+            "flip": {"recovered": True},
+            "torn": {"recovered": True},
+        },
+        "reload": {"replicas": 2, "acks": 2, "bit_identical": True},
+        "verify_overhead": {
+            "save_wall_s": 1.0, "verify_wall_s": 0.01,
+            "pct": 1.0, "limit_pct": 10.0,
+        },
+        "gates": {
+            "resume_exact": True, "zero_bricked": True,
+            "corrupt_modes_recovered": True,
+            "reload_bit_identical": True,
+            "verify_overhead_under_limit": True,
+            "fallback_observable": True,
+        },
+    }
+
+
+def test_ckpt_durable_schema_accepts_minimal_payload():
+    from distributeddeeplearning_tpu.obs.schema import (
+        validate_ckpt_durable_payload,
+    )
+
+    validate_ckpt_durable_payload(_minimal_ckpt_durable_payload())
+
+
+@pytest.mark.parametrize("breakage", [
+    ("resume", None),
+    ("corrupt_modes", {}),
+    ("reload", {"replicas": 2, "acks": 2}),
+    ("gates", {"resume_exact": True}),
+    ("verify_overhead", {"pct": 1.0}),
+])
+def test_ckpt_durable_schema_rejects_drifted_payloads(breakage):
+    from distributeddeeplearning_tpu.obs.schema import (
+        SchemaError,
+        validate_ckpt_durable_payload,
+    )
+
+    key, bad = breakage
+    payload = _minimal_ckpt_durable_payload()
+    if bad is None:
+        del payload[key]
+    else:
+        payload[key] = bad
+    with pytest.raises(SchemaError):
+        validate_ckpt_durable_payload(payload)
+
+
+def test_ckpt_durable_schema_rejects_no_chaos_run():
+    """An artifact with zero verification failures never exercised the
+    fallback — reject it (same principle as OBS_FLEET's no-death rule)."""
+    from distributeddeeplearning_tpu.obs.schema import (
+        SchemaError,
+        validate_ckpt_durable_payload,
+    )
+
+    payload = _minimal_ckpt_durable_payload()
+    payload["resume"]["verify_failures_observed"] = 0
+    with pytest.raises(SchemaError):
+        validate_ckpt_durable_payload(payload)
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(560)
+def test_bench_ckpt_faults_smoke(tmp_path):
+    """``bench.py --ckpt-faults`` end to end: schema-valid CKPT_DURABLE
+    artifact, all gates green (corrupt-latest resume exact, every
+    corruption mode recovered, fleet reload bit-identical, verify
+    overhead in budget)."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    from distributeddeeplearning_tpu.obs.schema import validate_artifact
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    report = tmp_path / "CKPT_DURABLE_r98.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DDLT_FAULTS", None)
+    proc = subprocess.run(
+        [
+            _sys.executable, os.path.join(repo, "bench.py"),
+            "--ckpt-faults", "--small",
+            "--report", str(report),
+        ],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    line = validate_artifact(str(report))
+    assert line["bench_revision"] >= 16
+    assert all(line["gates"].values()), line["gates"]
+    assert line["resume"]["exact"]
+    assert line["reload"]["bit_identical"]
+
+
+def test_policy_skipped_save_keeps_inflight_manifest_pending(tmp_path):
+    """A save() the manager's policy skips (save_interval_steps) must not
+    drop the still-in-flight previous generation's pending manifest —
+    orbax's should_save returns False WITHOUT waiting for the in-flight
+    commit, so the final step dir may not exist yet.  The manifest
+    commits at the next drain and the generation stays verified."""
+    ckpt = Checkpointer(str(tmp_path / "d"), save_interval_steps=2)
+    try:
+        assert ckpt.save(2, mini_state(2)) is True
+        assert ckpt.save(3, mini_state(3)) is False  # policy skip
+        ckpt.wait()
+        assert ckpt.latest_verified_step() == 2
+        assert load_manifest(tmp_path / "d" / "2") is not None
+        assert ckpt._pending_manifests == {}
+    finally:
+        ckpt.close()
+
+
+def test_fallback_evicts_corrupt_generation_so_step_resaves(tmp_path):
+    """The trainer-path restore DELETES a generation that failed
+    verification: left in place it would wedge its step forever (orbax
+    silently skips re-saving any step <= the latest existing one), so
+    the resumed run's recovered progress would never persist."""
+    ckpt = Checkpointer(str(tmp_path / "d"))
+    try:
+        ckpt.save(1, mini_state(1))
+        ckpt.save(2, mini_state(2, scale=2.0))
+        ckpt.wait()
+        corrupt_generation(tmp_path / "d" / "2", "flip")
+        _, step = ckpt.restore(mini_state())
+        assert step == 1
+        assert not (tmp_path / "d" / "2").exists()  # evicted, not wedged
+        # the resumed run re-saves the SAME step — and it must stick
+        assert ckpt.save(2, mini_state(2, scale=9.0)) is True
+        ckpt.wait()
+        state, step = ckpt.restore(mini_state())
+        assert step == 2
+        np.testing.assert_array_equal(
+            np.asarray(state.params["b"]), 9.0 * np.ones(64, np.float32)
+        )
+    finally:
+        ckpt.close()
+
+
+def test_restore_params_never_evicts_the_store(tmp_path):
+    """Serving is a read-only consumer: its fallback must leave the
+    (trainer-owned) corrupt generation in place."""
+    ckpt = Checkpointer(str(tmp_path / "d"))
+    try:
+        ckpt.save(1, mini_state(1))
+        ckpt.save(2, mini_state(2))
+        ckpt.wait()
+        corrupt_generation(tmp_path / "d" / "2", "flip")
+        _, step = ckpt.restore_params()
+        assert step == 1
+        assert (tmp_path / "d" / "2").exists()  # untouched
+    finally:
+        ckpt.close()

@@ -1,0 +1,391 @@
+"""Live weight reload (PR 13): engine in-place swap, the scheduler's idle
+barrier, prefix-cache invalidation, and the fleet's broadcast —
+post-reload greedy tokens pinned BIT-IDENTICAL to a fresh engine built
+from the reloaded weights."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.models.pipelined_transformer import (
+    init_params,
+)
+from distributeddeeplearning_tpu.serve import (
+    ContinuousBatchingScheduler,
+    InferenceEngine,
+    PagedInferenceEngine,
+    Request,
+)
+from distributeddeeplearning_tpu.utils import faults as faults_mod
+
+CFG = dict(num_layers=2, d_model=32, num_heads=4, d_ff=64, vocab_size=61,
+           max_len=32)
+HEADS = CFG["num_heads"]
+
+
+@pytest.fixture(scope="module")
+def params_old():
+    return init_params(jax.random.key(1), **CFG)
+
+
+@pytest.fixture(scope="module")
+def params_new():
+    return init_params(jax.random.key(2), **CFG)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    yield
+    faults_mod.install_plan("")
+
+
+def _dense(params, **kw):
+    kw.setdefault("num_heads", HEADS)
+    kw.setdefault("batch_slots", 2)
+    kw.setdefault("max_seq", 24)
+    return InferenceEngine(params, **kw)
+
+
+def _paged(params, **kw):
+    kw.setdefault("num_heads", HEADS)
+    kw.setdefault("batch_slots", 2)
+    kw.setdefault("max_seq", 24)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    return PagedInferenceEngine(params, **kw)
+
+
+def _run(engine, reqs, **kw):
+    res, rep = ContinuousBatchingScheduler(
+        engine, max_new_tokens=6, **kw
+    ).run([Request(uid=r.uid, prompt=list(r.prompt)) for r in reqs])
+    return {r.uid: list(r.tokens) for r in res}, rep
+
+
+REQS = [
+    Request(uid="a", prompt=[5, 9, 2, 17]),
+    Request(uid="b", prompt=[3, 3, 8]),
+    Request(uid="c", prompt=[11, 4, 4, 4, 7]),
+]
+
+
+# --------------------------------------------------------------------------
+# engine-level: in-place swap semantics
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("build", [_dense, _paged], ids=["dense", "paged"])
+def test_reload_then_serve_matches_fresh_engine(
+    build, params_old, params_new
+):
+    """After reload_params, greedy tokens are bit-identical to a fresh
+    engine constructed from the new weights — the reload IS a restart,
+    minus the restart."""
+    fresh_tokens, _ = _run(build(params_new), REQS)
+    engine = build(params_old)
+    _run(engine, REQS)  # serve a full batch on the OLD weights first
+    engine.reload_params(params_new)
+    reloaded_tokens, rep = _run(engine, REQS)
+    assert reloaded_tokens == fresh_tokens
+    # and the swap really changed the weights (old != new outputs)
+    old_tokens, _ = _run(build(params_old), REQS)
+    assert reloaded_tokens != old_tokens
+
+
+@pytest.mark.parametrize("build", [_dense, _paged], ids=["dense", "paged"])
+def test_reload_rejects_mismatched_tree(build, params_old):
+    engine = build(params_old)
+    bad = init_params(jax.random.key(3), **{**CFG, "d_model": 64})
+    with pytest.raises(ValueError, match="reload_params"):
+        engine.reload_params(bad)
+    # dtype change is a mismatch too (compiled programs key on avals)
+    cast = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16), params_old
+    )
+    with pytest.raises(ValueError, match="reload_params"):
+        engine.reload_params(cast)
+
+
+def test_paged_reload_refuses_live_slots(params_old, params_new):
+    engine = _paged(params_old)
+    engine.prefill_begin(0, [5, 9, 2], 4)
+    with pytest.raises(ValueError, match="live slots"):
+        engine.reload_params(params_new)
+
+
+def test_paged_reload_drops_prefix_cache(params_old, params_new):
+    """Prefix pages hold K/V computed by the OLD weights; a post-reload
+    hit on them would break the fresh-engine pin — the reload must drop
+    the table (and the pinned equality below proves no stale page is
+    reused)."""
+    shared = [7, 7, 7, 7, 1, 2, 3, 4]  # one full page + remainder
+    reqs = [
+        Request(uid="p1", prompt=shared + [9]),
+        Request(uid="p2", prompt=shared + [13]),
+    ]
+    # batch_slots=1: p2 admits after p1 completes, so p1's published
+    # prefix pages are there to hit
+    engine = _paged(params_old, batch_slots=1)
+    _run(engine, reqs)
+    assert engine.prefix_hit_tokens > 0  # the old-weight pages were shared
+    engine.reload_params(params_new)
+    assert engine.allocator.lookup_prefix(tuple(shared)) is None
+    reloaded, _ = _run(engine, reqs)
+    fresh, _ = _run(_paged(params_new, batch_slots=1), reqs)
+    assert reloaded == fresh
+
+
+# --------------------------------------------------------------------------
+# scheduler-level: the idle barrier
+# --------------------------------------------------------------------------
+
+
+def test_request_reload_is_a_barrier_between_requests(
+    params_old, params_new
+):
+    """Requests in flight at reload time finish on the OLD weights;
+    queued requests admitted after the barrier decode on the NEW weights
+    — each request sees exactly one weight set, and both halves are
+    bit-identical to single-weight-set runs."""
+    r1 = Request(uid="inflight", prompt=[5, 9, 2, 17])
+    r2 = Request(uid="queued", prompt=[3, 3, 8])
+    engine = _paged(params_old, batch_slots=1)  # r2 must queue behind r1
+    sched = ContinuousBatchingScheduler(engine, max_new_tokens=6)
+    applied = {"at_active": None}
+
+    def apply_reload():
+        applied["at_active"] = True
+        engine.reload_params(params_new)
+
+    fired = {"done": False}
+
+    def on_step(step):
+        if not fired["done"]:
+            fired["done"] = True
+            sched.request_reload(apply_reload)
+
+    res, _ = sched.run(
+        [Request(uid=r.uid, prompt=list(r.prompt)) for r in (r1, r2)],
+        on_step=on_step,
+    )
+    tokens = {r.uid: list(r.tokens) for r in res}
+    old_tokens, _ = _run(_paged(params_old, batch_slots=1), [r1])
+    new_tokens, _ = _run(_paged(params_new, batch_slots=1), [r2])
+    assert tokens["inflight"] == old_tokens["inflight"]
+    assert tokens["queued"] == new_tokens["queued"]
+    assert applied["at_active"] is True
+
+
+def test_request_reload_applies_before_first_admission(
+    params_old, params_new
+):
+    """A reload requested before run() applies at the first idle barrier:
+    every request decodes on the new weights."""
+    engine = _paged(params_old)
+    sched = ContinuousBatchingScheduler(engine, max_new_tokens=6)
+    sched.request_reload(lambda: engine.reload_params(params_new))
+    res, _ = sched.run(
+        [Request(uid=r.uid, prompt=list(r.prompt)) for r in REQS]
+    )
+    tokens = {r.uid: list(r.tokens) for r in res}
+    fresh, _ = _run(_paged(params_new), REQS)
+    assert tokens == fresh
+
+
+def test_failed_reload_keeps_serving_old_weights(params_old):
+    """apply_fn raising must not kill the loop or poison the weights —
+    serving continues on the old set (the fleet worker reports the error
+    over the outbox and the replica stays up)."""
+    engine = _paged(params_old)
+    sched = ContinuousBatchingScheduler(engine, max_new_tokens=6)
+
+    def bad_reload():
+        raise IOError("checkpoint store unreachable")
+
+    sched.request_reload(bad_reload)
+    res, rep = sched.run(
+        [Request(uid=r.uid, prompt=list(r.prompt)) for r in REQS]
+    )
+    tokens = {r.uid: list(r.tokens) for r in res}
+    old_tokens, _ = _run(_paged(params_old), REQS)
+    assert tokens == old_tokens
+    assert rep.errors == 0
+
+
+# --------------------------------------------------------------------------
+# fleet-level: broadcast + acks + bit-exactness across the boundary
+# --------------------------------------------------------------------------
+
+FLEET_MODEL = dict(num_layers=1, d_model=16, num_heads=2, d_ff=32,
+                   vocab_size=97, max_len=32)
+
+
+def _save_params_ckpt(tmp_path, name, seed):
+    import dataclasses as dc
+
+    from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
+
+    @dc.dataclass
+    class _S:
+        step: object
+        params: object
+        opt_state: object
+        batch_stats: object
+
+        def replace(self, **kw):
+            return dc.replace(self, **kw)
+
+    params = init_params(jax.random.key(seed), **FLEET_MODEL)
+    d = str(tmp_path / name)
+    ckpt = Checkpointer(d)
+    try:
+        ckpt.save(1, _S(step=jnp.int32(1), params=params,
+                        opt_state={}, batch_stats={}))
+        ckpt.wait()
+    finally:
+        ckpt.close()
+    return d, params
+
+
+@pytest.mark.timeout(280)
+def test_fleet_reload_bit_identical_to_fresh_engine(tmp_path):
+    """ISSUE 13 acceptance (test half): serve a batch on checkpoint A,
+    FleetRouter.reload(checkpoint B) with every replica acking, serve a
+    second batch on the SAME worker processes — whose greedy tokens must
+    be bit-identical to a fresh engine built from checkpoint B."""
+    from distributeddeeplearning_tpu.serve import ReplicaSpec
+    from distributeddeeplearning_tpu.serve.fleet import FleetRouter
+    from distributeddeeplearning_tpu.serve.scheduler import (
+        synthetic_requests,
+    )
+
+    dir_a, _ = _save_params_ckpt(tmp_path, "w-a", seed=1)
+    dir_b, params_b = _save_params_ckpt(tmp_path, "w-b", seed=2)
+    spec = ReplicaSpec(
+        checkpoint_dir=dir_a,
+        num_heads=2, batch_slots=2, max_seq=32, kv_layout="paged",
+        page_size=8, prefill_chunk=8, max_new_tokens=8,
+    )
+    batch_a = synthetic_requests(
+        4, vocab_size=FLEET_MODEL["vocab_size"], max_prompt=8,
+        rng=np.random.default_rng(0),
+    )
+    batch_b = [
+        Request(uid=f"post{i}", prompt=r.prompt)
+        for i, r in enumerate(synthetic_requests(
+            4, vocab_size=FLEET_MODEL["vocab_size"], max_prompt=8,
+            rng=np.random.default_rng(1),
+        ))
+    ]
+    router = FleetRouter(spec, replicas=2, faults="")
+    _, rep_a = router.serve(batch_a, shutdown=False)
+    assert rep_a.completed_ok == len(batch_a)
+    acks = router.reload(dir_b)
+    assert sorted(acks) == [0, 1]
+    assert all(a["ok"] for a in acks.values()), acks
+    assert all(a["step"] == 1 for a in acks.values())
+    res_b, rep_b = router.serve(batch_b)
+    assert rep_b.completed_ok == len(batch_b)
+    assert rep_b.reloads == 1
+
+    ref_engine = PagedInferenceEngine(
+        params_b, num_heads=2, batch_slots=2, max_seq=32, page_size=8,
+        prefill_chunk=8, rng=jax.random.key(spec.seed),
+    )
+    ref_res, _ = ContinuousBatchingScheduler(
+        ref_engine, max_new_tokens=8,
+    ).run([Request(uid=r.uid, prompt=list(r.prompt)) for r in batch_b])
+    ref_tokens = {r.uid: list(r.tokens) for r in ref_res}
+    for r in res_b:
+        assert r.finish_reason in ("eos", "length")
+        assert list(r.tokens) == ref_tokens[r.uid], r.uid
+
+
+@pytest.mark.timeout(280)
+def test_fleet_reload_mid_serve_from_another_thread(tmp_path):
+    """reload() while a serve is running: the dispatch loop harvests the
+    acks (no message stealing) and the run completes with every request
+    in a terminal state."""
+    from distributeddeeplearning_tpu.serve import ReplicaSpec
+    from distributeddeeplearning_tpu.serve.fleet import FleetRouter
+    from distributeddeeplearning_tpu.serve.scheduler import (
+        synthetic_requests,
+    )
+
+    dir_a, _ = _save_params_ckpt(tmp_path, "m-a", seed=1)
+    dir_b, _ = _save_params_ckpt(tmp_path, "m-b", seed=2)
+    spec = ReplicaSpec(
+        checkpoint_dir=dir_a,
+        num_heads=2, batch_slots=2, max_seq=32, kv_layout="paged",
+        page_size=8, prefill_chunk=8, max_new_tokens=8,
+    )
+    reqs = synthetic_requests(
+        8, vocab_size=FLEET_MODEL["vocab_size"], max_prompt=8,
+        rng=np.random.default_rng(3),
+    )
+    router = FleetRouter(spec, replicas=2, faults="")
+    acks_box = {}
+    stop = threading.Event()
+
+    def reload_when_live():
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline and not stop.is_set():
+            if any(m.ready for m in router._members):
+                acks_box.update(router.reload(dir_b, timeout_s=180))
+                return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=reload_when_live, daemon=True)
+    t.start()
+    try:
+        results, report = router.serve(reqs)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert sum(report.finish_reasons.values()) == len(reqs)
+    assert report.lost_requests == 0
+    # at least one replica was live and acked (a replica may have been
+    # mid-spawn when the broadcast targeted the ready set)
+    assert acks_box and all(a.get("ok") for a in acks_box.values()), acks_box
+
+
+@pytest.mark.timeout(280)
+def test_serve_after_shutdown_respawns_workers(tmp_path):
+    """A serve() after a shutdown serve must RESPAWN (the members are
+    terminal), not dispatch onto dead inboxes; and reload() with no live
+    replica refuses loudly instead of waiting out its timeout."""
+    from distributeddeeplearning_tpu.serve import ReplicaSpec
+    from distributeddeeplearning_tpu.serve.fleet import FleetRouter
+    from distributeddeeplearning_tpu.serve.scheduler import (
+        synthetic_requests,
+    )
+
+    dir_a, _ = _save_params_ckpt(tmp_path, "r-a", seed=1)
+    spec = ReplicaSpec(
+        checkpoint_dir=dir_a,
+        num_heads=2, batch_slots=2, max_seq=32, kv_layout="paged",
+        page_size=8, prefill_chunk=8, max_new_tokens=6,
+    )
+    router = FleetRouter(spec, replicas=2, faults="")
+    batch1 = synthetic_requests(
+        3, vocab_size=FLEET_MODEL["vocab_size"], max_prompt=8,
+        rng=np.random.default_rng(5),
+    )
+    _, rep1 = router.serve(batch1)  # default shutdown=True
+    assert rep1.completed_ok == len(batch1)
+    assert all(m.dead for m in router._members)
+    with pytest.raises(RuntimeError, match="no live ready replica"):
+        router.reload(dir_a)
+    batch2 = [
+        Request(uid=f"second-{i}", prompt=r.prompt)
+        for i, r in enumerate(synthetic_requests(
+            3, vocab_size=FLEET_MODEL["vocab_size"], max_prompt=8,
+            rng=np.random.default_rng(6),
+        ))
+    ]
+    _, rep2 = router.serve(batch2)  # fresh workers, not a hang
+    assert rep2.completed_ok == len(batch2)

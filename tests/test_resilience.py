@@ -191,6 +191,139 @@ def test_retry_call_bounded_and_raises_last_error():
     assert len(calls) == 4 and len(slept) == 3  # bounded: no infinite loop
 
 
+def test_retry_call_deadline_stops_retrying_when_budget_spent():
+    """deadline_s bounds the WHOLE retry sequence: once the (injected)
+    clock passes the budget, the current failure re-raises immediately —
+    no further sleeps, no further attempts (the emergency-checkpoint
+    path's grace-window contract)."""
+    clock = {"t": 0.0}
+    calls, slept = [], []
+
+    def tick_sleep(d):
+        slept.append(d)
+        clock["t"] += d
+
+    def always_fails():
+        calls.append(1)
+        clock["t"] += 2.0  # each attempt itself burns wall clock
+        raise IOError("still down")
+
+    with pytest.raises(IOError, match="still down"):
+        retry_call(
+            always_fails, retries=10, base_delay=1.0, max_delay=1.0,
+            sleep=tick_sleep, rng=random.Random(0),
+            clock=lambda: clock["t"], deadline_s=5.0,
+        )
+    # attempt 1 (t=2), sleep, attempt 2 (t>=4), sleep clamped, attempt 3
+    # (t>=6 > 5) -> raise without sleeping.  Far fewer than retries=10.
+    assert len(calls) <= 3
+    assert clock["t"] <= 5.0 + 2.0 + 1.0  # never slept past the window
+
+
+def test_retry_call_deadline_clamps_sleep_to_remaining_window():
+    clock = {"t": 0.0}
+    slept = []
+
+    def tick_sleep(d):
+        slept.append(d)
+        clock["t"] += d
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise IOError("transient")
+        return "ok"
+
+    # base_delay huge: without the deadline the first sleep would be up
+    # to 100s; the 3s budget must clamp it
+    assert retry_call(
+        flaky, retries=3, base_delay=100.0, max_delay=100.0,
+        sleep=tick_sleep, rng=random.Random(1),
+        clock=lambda: clock["t"], deadline_s=3.0,
+    ) == "ok"
+    assert len(slept) == 1 and slept[0] <= 3.0
+
+
+def test_retry_call_deadline_none_keeps_unbounded_behavior():
+    calls, slept = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 4:
+            raise IOError("transient")
+        return "ok"
+
+    assert retry_call(
+        flaky, retries=4, sleep=slept.append, rng=random.Random(0),
+    ) == "ok"
+    assert len(calls) == 4 and len(slept) == 3
+
+
+def test_retry_call_rejects_negative_deadline():
+    with pytest.raises(ValueError, match="deadline_s"):
+        retry_call(lambda: None, deadline_s=-1.0)
+
+
+def test_preemption_guard_remaining_grace(monkeypatch):
+    from distributeddeeplearning_tpu.train import resilience as res
+
+    clock = {"t": 100.0}
+    monkeypatch.setattr(res.time, "monotonic", lambda: clock["t"])
+    guard = res.PreemptionGuard(grace_s=30.0)
+    assert guard.remaining_grace() is None  # no signal yet
+    guard.trigger("injected")
+    clock["t"] += 12.0
+    assert guard.remaining_grace() == pytest.approx(18.0)
+    clock["t"] += 100.0
+    assert guard.remaining_grace() == 0.0  # floored, never negative
+    # without a configured window the guard reports None (no deadline)
+    g2 = res.PreemptionGuard()
+    g2.trigger("injected")
+    assert g2.remaining_grace() is None
+
+
+def test_emergency_stop_plumbs_grace_deadline_into_checkpointer():
+    """Trainer._emergency_stop must pass the REMAINING grace window into
+    both save() and wait() as their retry deadline — re-read before each
+    phase (save may consume most of the budget)."""
+    from distributeddeeplearning_tpu.train.loop import Trainer, TrainerConfig
+    from distributeddeeplearning_tpu.train.resilience import (
+        PreemptionError as PE,
+        PreemptionGuard,
+    )
+
+    class FakeCkpt:
+        def __init__(self):
+            self.deadlines = []
+
+        def save(self, step, state, *, deadline_s=None):
+            self.deadlines.append(("save", deadline_s))
+
+        def wait(self, *, deadline_s=None):
+            self.deadlines.append(("wait", deadline_s))
+
+    trainer = Trainer.__new__(Trainer)  # no mesh/step needed for this path
+    trainer.checkpointer = FakeCkpt()
+    trainer.config = TrainerConfig(steps_per_epoch=1)
+    guard = PreemptionGuard(grace_s=60.0)
+    guard.trigger("injected preempt")
+    with pytest.raises(PE):
+        trainer._emergency_stop(5, None, None, guard=guard)
+    kinds = [k for k, _ in trainer.checkpointer.deadlines]
+    assert kinds == ["save", "wait"]
+    for _, deadline in trainer.checkpointer.deadlines:
+        assert deadline is not None and 0.0 <= deadline <= 60.0
+    # no guard: deadlines stay None (unknown window)
+    trainer.checkpointer = FakeCkpt()
+    with pytest.raises(PE):
+        trainer._emergency_stop(6, None, None, guard=None)
+    assert trainer.checkpointer.deadlines == [
+        ("save", None), ("wait", None)
+    ]
+
+
 def test_rate_limited_logger_suppresses_within_interval():
     clock = {"t": 0.0}
     lines = []
