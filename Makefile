@@ -12,8 +12,8 @@ PROJECT ?= smoke-test-project
 IMAGE ?= ddlt-control
 DATA_DIR ?= /data
 
-.PHONY: install test test-fast lint generate clean bench-smoke bench scaling \
-        dryrun docker-build docker-run docker-bash docker-stop
+.PHONY: install test test-fast lint perf-history generate clean bench-smoke \
+        bench scaling dryrun docker-build docker-run docker-bash docker-stop
 
 install:
 	pip install -e .
@@ -29,6 +29,12 @@ test-fast:
 # CPU pod itself, so this works with no TPU attached).
 lint:
 	python -m distributeddeeplearning_tpu.cli.main lint
+
+# Perf-trajectory gate (obs/history.py): every committed <KIND>_r{NN}.json
+# parsed into one metric timeline; non-zero exit when a tracked metric
+# regressed past its tolerance between the two newest revisions.
+perf-history:
+	python -m distributeddeeplearning_tpu.cli.main obs history --gate
 
 # Smoke-generate a project non-interactively (reference Makefile:5-16).
 generate:

@@ -42,7 +42,7 @@ V100_TF_CNN_BENCHMARKS_IMG_SEC = 720.0
 #: ``_rNN`` suffix (the drift that left COMMS at r09 while RESILIENCE sat
 #: at r07).  Committed artifacts keep their historical names; NEW runs
 #: write ``<KIND>_r{BENCH_REVISION}.json``.
-BENCH_REVISION = 16
+BENCH_REVISION = 17
 
 
 def artifact_name(kind: str) -> str:
@@ -2014,6 +2014,193 @@ def _run_faults(args) -> int:
     return 0 if line["completed_exact"] and faulted.returncode == 0 else 1
 
 
+def _run_goodput(args) -> int:
+    """Goodput-ledger chaos benchmark — the ``GOODPUT_r{NN}.json``
+    artifact: a short training run under the REAL ``ddlt train
+    --max-restarts`` supervisor with an injected preemption AND an
+    anomaly abort, its wall classified 100% by the goodput ledger
+    (``obs/goodput.py``), stitched across the restart incarnations.
+    Gates (return code 1 on violation):
+
+    - **residual_under_limit**: the category sum covers total wall
+      within the ±2% unaccounted-time gate (a ledger that lost time
+      reports optimistic goodput — that is the bug class the gate
+      exists for);
+    - **redone_matches_supervisor**: the ledger's ``steps_redone``
+      count equals the supervisor's own ``redone_steps`` accounting
+      EXACTLY (two independent implementations of "which steps were
+      re-executed" must agree);
+    - **recovery_observed**: the chaos run shows nonzero ``recovery``
+      wall and at least one restart — a fault-free artifact would
+      prove nothing about restart durability;
+    - **completed_exact**: the run still reaches the exact final step;
+    - **trajectory_green**: the perf-history tracker
+      (``obs/history.py``) runs green over every committed artifact —
+      the trajectory digest travels inside this artifact.
+
+    The default fault spec injects ``preempt@6`` (emergency checkpoint
+    at the exact step → zero redone work, pure recovery gap) and three
+    consecutive ``nan_loss`` steps ending ON the last step (anomaly
+    abort at step 15 with the newest verified checkpoint at 12 → exactly
+    2 redone steps), so both restart flavors land in one ledger.
+    """
+    import os
+    import re
+    import subprocess
+    import tempfile
+    import time as _time
+
+    import jax
+
+    from distributeddeeplearning_tpu.obs import goodput as goodput_mod
+    from distributeddeeplearning_tpu.obs import history as history_mod
+    from distributeddeeplearning_tpu.obs.schema import (
+        SchemaError,
+        validate_goodput_payload,
+    )
+
+    epochs, spe, every = 3, 5, 4
+    total_steps = epochs * spe
+    work_dir = tempfile.mkdtemp(prefix="ddlt-goodput-")
+    ledger_path = os.path.join(work_dir, "goodput.jsonl")
+    ckpt_dir = os.path.join(work_dir, "ckpt")
+    # accounting bench, not a throughput bench: tiny dims keep the CPU
+    # chaos run short while every category still accrues real wall
+    batch, image = (4, 24) if args.small else (8, 32)
+
+    argv = [
+        sys.executable, "-m", "distributeddeeplearning_tpu.cli.main",
+        "train", "imagenet",
+        "--max-restarts", str(args.goodput_max_restarts),
+        "--model", "resnet18",
+        "--data_format", "synthetic",
+        "--epochs", str(epochs),
+        "--steps_per_epoch", str(spe),
+        "--batch_size", str(batch),
+        "--image_size", str(image),
+        "--num_classes", "11",
+        "--compute_dtype", "float32",
+        "--checkpoint_every_steps", str(every),
+        "--seed", "0",
+        "--skip_nonfinite", "true",
+        "--anomaly_max_consecutive", "3",
+        "--save_filepath", ckpt_dir,
+        "--goodput_path", ledger_path,
+    ]
+    env = dict(os.environ)
+    env.pop("DDLT_FAULTS", None)
+    if args.goodput_spec:
+        env["DDLT_FAULTS"] = args.goodput_spec
+    print(
+        f"[goodput] {total_steps}-step chaos run under the supervisor "
+        f"(faults: {args.goodput_spec or 'none'})", file=sys.stderr,
+    )
+    t0 = _time.perf_counter()
+    proc = subprocess.run(
+        argv, env=env, text=True, capture_output=True, timeout=1800,
+    )
+    child_wall = _time.perf_counter() - t0
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        print(
+            f"[goodput] supervised run failed (rc={proc.returncode})",
+            file=sys.stderr,
+        )
+        return 1
+
+    m = re.search(
+        r"completed at step (\d+): restarts=(\d+) redone_steps=(\d+) "
+        r"anomalous_steps=(\d+)",
+        proc.stdout,
+    )
+    final_step = int(m.group(1)) if m else None
+    sup_restarts = int(m.group(2)) if m else None
+    sup_redone = int(m.group(3)) if m else None
+    anomalous = int(m.group(4)) if m else None
+
+    merged = goodput_mod.stitch(ledger_path)
+    ledger = goodput_mod.summarize_ledger(merged)
+
+    # the perf trajectory over every committed artifact rides along:
+    # the GOODPUT artifact is where goodput-over-time and perf-over-
+    # revisions meet
+    points = history_mod.load_points(".")
+    timeline = history_mod.build_timeline(points)
+    regressions = history_mod.check_gates(timeline)
+    trajectory = history_mod.timeline_digest(timeline, regressions)
+
+    gates = {
+        "residual_under_limit": bool(ledger["residual_under_limit"]),
+        "redone_matches_supervisor": (
+            sup_redone is not None
+            and ledger["counts"].get("steps_redone") == sup_redone
+        ),
+        "recovery_observed": (
+            ledger["seconds"]["recovery"] > 0.0
+            and (sup_restarts or 0) >= 1
+        ),
+        "completed_exact": final_step == total_steps,
+        "trajectory_green": bool(trajectory["green"]),
+    }
+    line = {
+        "metric": "train_goodput_fraction",
+        "value": ledger["goodput_fraction"],
+        "unit": "fraction",
+        "vs_baseline": None,
+        "bench_revision": BENCH_REVISION,
+        "platform": jax.default_backend(),
+        "virtual_pod": _is_virtual_pod(),
+        "faults_spec": args.goodput_spec,
+        "model": "resnet18",
+        "total_steps": total_steps,
+        "child_wall_s": round(child_wall, 2),
+        # the ledger accounts the FIT (first segment begin -> last end);
+        # process boot/teardown around it is not training wall
+        "wall_includes_process_start": False,
+        "supervisor": {
+            "max_restarts": args.goodput_max_restarts,
+            "restarts": sup_restarts if sup_restarts is not None else -1,
+            "redone_steps": sup_redone if sup_redone is not None else -1,
+            "anomalous_steps": anomalous,
+            "final_step": final_step,
+            "cmd": f"ddlt train --max-restarts {args.goodput_max_restarts}",
+        },
+        "ledger": ledger,
+        "segments": merged["segment_rows"],
+        "restart_rows": merged["restart_rows"],
+        "trajectory": trajectory,
+        "gates": gates,
+    }
+    try:
+        validate_goodput_payload(line)
+    except SchemaError as exc:
+        print(f"[goodput] artifact failed its own schema: {exc}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps({
+        k: line[k] for k in (
+            "metric", "value", "unit", "bench_revision", "platform",
+            "virtual_pod", "faults_spec", "gates",
+        )
+    }))
+    report_path = args.report or artifact_name("GOODPUT")
+    with open(report_path, "w") as f:
+        json.dump(line, f, indent=2)
+        f.write("\n")
+    print(f"[goodput] report -> {report_path}", file=sys.stderr)
+    for name, ok in gates.items():
+        if not ok:
+            print(f"[goodput] GATE FAILED: {name}", file=sys.stderr)
+    print(
+        f"[goodput] goodput_fraction={ledger['goodput_fraction']} "
+        f"unaccounted_pct={ledger['unaccounted_pct']} "
+        f"recovery_s={ledger['seconds']['recovery']} "
+        f"steps_redone={ledger['counts'].get('steps_redone')} "
+        f"(supervisor {sup_redone})", file=sys.stderr,
+    )
+    return 0 if all(gates.values()) else 1
+
+
 def _run_serve_faults(args) -> int:
     """Serving chaos benchmark: the supervised replica fleet
     (``serve/fleet.py``) driven through an injected serve-side fault
@@ -3255,6 +3442,30 @@ def main() -> int:
         help="supervisor restart budget for --faults",
     )
     parser.add_argument(
+        "--goodput",
+        action="store_true",
+        help="goodput-ledger chaos benchmark: a short training run under "
+        "the real ddlt train --max-restarts supervisor with an injected "
+        "preemption + anomaly abort, 100%% of its wall classified by the "
+        "goodput ledger (obs/goodput.py) and stitched across restarts; "
+        "emits GOODPUT_r{NN}.json with the ledger, the supervisor-matched "
+        "redone/recovery accounting and the perf-trajectory digest "
+        "(obs/history.py), gated on the <=2%% unaccounted-time residual",
+    )
+    parser.add_argument(
+        "--goodput-spec",
+        default="preempt@6,nan_loss@13,nan_loss@14,nan_loss@15",
+        help="DDLT_FAULTS schedule for --goodput (the default lands one "
+        "exact-resume preemption AND one anomaly abort that re-does "
+        "exactly 2 steps, so both restart flavors show in one ledger)",
+    )
+    parser.add_argument(
+        "--goodput-max-restarts",
+        type=int,
+        default=2,
+        help="supervisor restart budget for --goodput",
+    )
+    parser.add_argument(
         "--serve-faults",
         action="store_true",
         help="serving chaos benchmark: the supervised replica fleet "
@@ -3418,6 +3629,13 @@ def main() -> int:
         parser.error("--serve and --devices are mutually exclusive")
     if args.faults and (args.serve or args.devices or args.data):
         parser.error("--faults is exclusive with --serve/--devices/--data")
+    if args.goodput and (args.serve or args.devices or args.data
+                         or args.faults or args.comms or args.quant
+                         or args.obs or args.obs_fleet or args.spec
+                         or args.serve_faults or args.ckpt_faults):
+        parser.error(
+            "--goodput is exclusive with the other benchmark modes"
+        )
     if args.serve_faults and (args.serve or args.devices or args.data
                               or args.faults or args.comms or args.quant
                               or args.obs):
@@ -3543,6 +3761,8 @@ def main() -> int:
         )
     if args.faults:
         return _run_faults(args)
+    if args.goodput:
+        return _run_goodput(args)
     if args.serve_faults:
         return _run_serve_faults(args)
     if args.ckpt_faults:

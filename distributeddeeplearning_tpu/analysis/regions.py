@@ -49,7 +49,11 @@ HOT_REGIONS: Tuple[HotRegion, ...] = (
         module="distributeddeeplearning_tpu.train.loop",
         qualname="Trainer._fit_inner",
         locator="for step_i in range",
-        landmarks=("self.train_step(", "trace.span("),
+        # goodput.mark_step is load-bearing instrumentation: the ledger's
+        # 100%-of-wall accounting is built from these marks, so losing
+        # them is a lint finding, not a silent accounting hole
+        landmarks=("self.train_step(", "trace.span(",
+                   "self.goodput.mark_step("),
         # the anomaly detector's documented one-sync-per-step price:
         # loss, grad_norm and the anomalous flag read on three marked lines
         sync_budget=3,
@@ -151,6 +155,7 @@ JIT_BUILDER_REGIONS: Tuple[HotRegion, ...] = (
 _OBS_TRACE = "distributeddeeplearning_tpu.obs.trace"
 _OBS_REG = "distributeddeeplearning_tpu.obs.registry"
 _OBS_RECORDER = "distributeddeeplearning_tpu.obs.recorder"
+_OBS_GOODPUT = "distributeddeeplearning_tpu.obs.goodput"
 OBS_HOT_REGIONS: Tuple[HotRegion, ...] = (
     HotRegion(name="obs-tracer-span", module=_OBS_TRACE, qualname="Tracer.span"),
     HotRegion(name="obs-tracer-event", module=_OBS_TRACE, qualname="Tracer.event"),
@@ -195,6 +200,24 @@ OBS_HOT_REGIONS: Tuple[HotRegion, ...] = (
         module=_OBS_RECORDER,
         qualname="_RecorderSpan.__exit__",
         landmarks=("self._rec.record",),
+    ),
+    # the goodput ledger's record path: called at EVERY phase boundary
+    # of the trainer hot loop — one perf_counter read + dict math on
+    # host floats, ZERO designed syncs (a category recorded via a
+    # host-coercing float(...) of a device value is exactly the seeded
+    # lint_violations fixture bug; markers would not waive a new sync
+    # into this budget without editing this registry)
+    HotRegion(
+        name="obs-goodput-mark",
+        module=_OBS_GOODPUT,
+        qualname="GoodputLedger.mark",
+        landmarks=("time.perf_counter()",),
+    ),
+    HotRegion(
+        name="obs-goodput-mark-step",
+        module=_OBS_GOODPUT,
+        qualname="GoodputLedger.mark_step",
+        landmarks=("self.mark(",),
     ),
 )
 

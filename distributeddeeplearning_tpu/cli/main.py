@@ -544,6 +544,28 @@ def build_parser() -> argparse.ArgumentParser:
             help="output dir: device trace + merged.trace.json + "
             "obs-metrics.jsonl (default ./ddlt-obs)",
         )
+    obs_history = obs_sub.add_parser(
+        "history",
+        help="perf-trajectory tracker (obs/history.py): parse every "
+        "committed <KIND>_r{NN}.json through the schema validators into "
+        "one metric timeline, print per-series sparkline deltas; "
+        "--gate exits 1 when a tracked metric regressed past its "
+        "tolerance between the two newest revisions (make perf-history)",
+    )
+    obs_history.add_argument(
+        "--root", default=".",
+        help="directory holding the committed *_r*.json artifacts "
+        "(default: the current directory)",
+    )
+    obs_history.add_argument(
+        "--json", action="store_true",
+        help="machine-readable trajectory digest on stdout",
+    )
+    obs_history.add_argument(
+        "--gate", action="store_true",
+        help="fail (rc 1) on any tracked metric regressing past its "
+        "per-metric tolerance (obs/history.TOLERANCES)",
+    )
 
     inter_p = sub.add_parser(
         "interactive",
@@ -1208,6 +1230,10 @@ def _cmd_train(args, extra: List[str]) -> int:
         result, restarts = resilience.supervise(
             attempt, max_restarts=args.max_restarts, restart_on=restartable,
             on_restart=on_restart,
+            # restart markers interleave with the Trainer's per-attempt
+            # segments in the goodput ledger (obs/goodput.py), so the
+            # stitched file carries the SUPERVISOR's restart evidence too
+            ledger_path=kwargs.get("goodput_path"),
         )
     except resilience.PreemptionError as exc:
         print(
@@ -1237,6 +1263,24 @@ def _cmd_train(args, extra: List[str]) -> int:
         )
     else:
         print(f"[train] {workload} completed: restarts={restarts}")
+    if kwargs.get("goodput_path"):
+        # run-level goodput summary over the stitched per-attempt
+        # segments (the same accounting bench.py --goodput artifacts)
+        from distributeddeeplearning_tpu.obs import goodput
+
+        try:
+            summary = goodput.summarize_ledger(
+                goodput.stitch(kwargs["goodput_path"])
+            )
+            print(
+                f"[train] goodput_fraction={summary['goodput_fraction']} "
+                f"recovery_s={summary['seconds']['recovery']} "
+                f"steps_redone={summary['counts'].get('steps_redone', 0)} "
+                f"unaccounted_pct={summary['unaccounted_pct']}"
+            )
+        except Exception as exc:  # accounting must never fail the run
+            print(f"[train] goodput summary unavailable: {exc}",
+                  file=sys.stderr)
     return 0
 
 
@@ -1762,6 +1806,16 @@ def _cmd_obs(args) -> int:
     import json as _json
     import os
 
+    if args.obs_command == "history":
+        # pure artifact analysis — no jax, no backend init: the preflight
+        # use (make perf-history) must stay seconds-cheap
+        from distributeddeeplearning_tpu.obs.history import run_history
+
+        rc, output = run_history(
+            args.root, gate=args.gate, as_json=args.json
+        )
+        print(output)
+        return rc
     if args.obs_command == "fleet":
         return _cmd_obs_fleet(args)
 

@@ -20,12 +20,21 @@ The package every layer reports through (ISSUE 6 / OBS_r11):
 - :mod:`obs.fleet` — fleet-scale merge: worker trace shards aligned
   onto the router clock, bucket-merged cross-process metrics, and the
   declarative :class:`~obs.fleet.SLOSpec` gate;
+- :mod:`obs.goodput` — the goodput ledger: a zero-sync, restart-durable
+  wall-clock ledger classifying 100% of a training run into named
+  categories (productive/redone steps, compile, data wait, checkpoint
+  blocking, eval, recovery), with run-level MFU and the ≤2%
+  unaccounted-residual gate;
+- :mod:`obs.history` — the perf-trajectory tracker: every committed
+  ``*_r*.json`` read as one revision-keyed metric timeline, with a
+  per-metric tolerance gate (``ddlt obs history --gate``);
 - :mod:`obs.schema` — artifact validation, so committed ``*_r*.json``
   drift fails tier-1 instead of rotting.
 
-Entry points: ``ddlt obs {train,serve,fleet}``, ``ddlt serve
---trace-dir`` and ``bench.py --obs`` / ``--obs-fleet`` (the
-``OBS_r{NN}.json`` / ``OBS_FLEET_r{NN}.json`` artifacts).
+Entry points: ``ddlt obs {train,serve,fleet,history}``, ``ddlt serve
+--trace-dir``, ``make perf-history`` and ``bench.py --obs`` /
+``--obs-fleet`` / ``--goodput`` (the ``OBS_r{NN}.json`` /
+``OBS_FLEET_r{NN}.json`` / ``GOODPUT_r{NN}.json`` artifacts).
 """
 
 from distributeddeeplearning_tpu.obs.recorder import (

@@ -32,7 +32,21 @@ committed revision artifact:
   (corrupt-latest resume landing on the exact verified step, a per-
   corruption-mode recovery matrix, the live-reload bit-exactness verdict
   and the verify-overhead budget) — the evidence the checkpoint layer's
-  "storage is not trusted" story rests on.
+  "storage is not trusted" story rests on;
+- ``GOODPUT_*`` artifacts validate against the goodput-ledger schema:
+  every category present, the category sum covering total wall within
+  the residual gate (a payload whose categories don't sum to wall is
+  REJECTED — optimistic goodput from lost time is the failure mode),
+  goodput fraction in [0, 1], MFU numeric or explicitly omitted with a
+  reason, chaos accounting (recovery + redone steps) and the
+  trajectory-digest block.
+
+Prefix dispatch is an ORDERED most-specific-first table
+(:data:`_PREFIX_VALIDATORS`): the first matching prefix wins, so a name
+matching two prefixes (``OBS_FLEET_*`` also matches ``OBS_*``) binds to
+its specific schema and every specific kind (``GOODPUT_*`` included) is
+matched before the generic ``*_r*.json`` fallback checks are all that
+guard it.
 """
 
 from __future__ import annotations
@@ -48,6 +62,7 @@ __all__ = [
     "validate_serve_resilience_payload",
     "validate_spec_payload",
     "validate_ckpt_durable_payload",
+    "validate_goodput_payload",
 ]
 
 #: latency blocks whose percentile keys are a cross-artifact contract
@@ -545,6 +560,148 @@ def validate_ckpt_durable_payload(payload: Dict[str, Any]) -> None:
         raise SchemaError("; ".join(errors))
 
 
+def validate_goodput_payload(payload: Dict[str, Any]) -> None:
+    """Strict schema for the ``GOODPUT_r{NN}.json`` artifact body.
+
+    The goodput ledger's evidence trail: 100% of a chaos training run's
+    wall classified into the named categories, the category sum covering
+    total wall within the residual gate (THE rejection: a ledger that
+    lost time would otherwise report optimistic goodput), goodput
+    fraction and (on TPU) MFU, the supervisor-matched redone/recovery
+    accounting, and the perf-trajectory digest over committed artifacts.
+    """
+    from distributeddeeplearning_tpu.obs.goodput import CATEGORIES
+
+    errors: List[str] = []
+
+    def require(cond: bool, msg: str) -> None:
+        if not cond:
+            errors.append(msg)
+
+    for key in ("metric", "value", "unit", "bench_revision", "platform",
+                "virtual_pod", "faults_spec", "supervisor", "ledger",
+                "trajectory", "gates"):
+        require(key in payload, f"missing top-level key {key!r}")
+
+    ledger = payload.get("ledger")
+    if isinstance(ledger, dict):
+        total = ledger.get("total_wall_s")
+        require(
+            isinstance(total, (int, float)) and total > 0,
+            "ledger.total_wall_s must be positive",
+        )
+        seconds = ledger.get("seconds")
+        if isinstance(seconds, dict):
+            for cat in CATEGORIES:
+                require(
+                    isinstance(seconds.get(cat), (int, float))
+                    and seconds.get(cat, -1.0) >= 0.0,
+                    f"ledger.seconds.{cat} must be a non-negative number "
+                    "(every category is always present — absence means "
+                    "the emit site dropped one)",
+                )
+            limit = ledger.get("residual_limit_pct")
+            require(
+                isinstance(limit, (int, float)) and limit > 0,
+                "ledger.residual_limit_pct must be positive",
+            )
+            if (
+                isinstance(total, (int, float)) and total > 0
+                and isinstance(limit, (int, float))
+                and all(
+                    isinstance(seconds.get(c), (int, float))
+                    for c in CATEGORIES
+                )
+            ):
+                accounted = sum(float(seconds[c]) for c in CATEGORIES)
+                residual_pct = abs(total - accounted) / total * 100.0
+                require(
+                    residual_pct <= float(limit) + 1e-6,
+                    f"ledger categories sum to {round(accounted, 4)}s but "
+                    f"total wall is {round(total, 4)}s — "
+                    f"{round(residual_pct, 2)}% unaccounted exceeds the "
+                    f"{limit}% residual gate (the ledger lost time)",
+                )
+        else:
+            require(False, "ledger.seconds must be a dict")
+        gf = ledger.get("goodput_fraction")
+        require(
+            isinstance(gf, (int, float)) and 0.0 <= gf <= 1.0,
+            "ledger.goodput_fraction must be in [0, 1]",
+        )
+        mfu = ledger.get("mfu")
+        require(
+            isinstance(mfu, (int, float)) or (
+                mfu is None
+                and isinstance(ledger.get("mfu_omitted_reason"), str)
+            ),
+            "ledger.mfu must be numeric, or null WITH mfu_omitted_reason "
+            "(off-TPU runs omit MFU explicitly, never silently)",
+        )
+        counts = ledger.get("counts")
+        require(
+            isinstance(counts, dict)
+            and isinstance(counts.get("steps"), int)
+            and isinstance(counts.get("steps_redone"), int)
+            and isinstance(counts.get("segments"), int),
+            "ledger.counts must carry steps / steps_redone / segments ints",
+        )
+    else:
+        require(False, "ledger must be a dict")
+
+    supervisor = payload.get("supervisor")
+    if isinstance(supervisor, dict):
+        for key in ("restarts", "redone_steps"):
+            require(
+                isinstance(supervisor.get(key), int),
+                f"supervisor.{key} must be an int",
+            )
+    else:
+        require(False, "supervisor must be a dict (the restart "
+                       "supervisor's own accounting, matched by the gate)")
+
+    trajectory = payload.get("trajectory")
+    if isinstance(trajectory, dict):
+        require(
+            isinstance(trajectory.get("green"), bool)
+            and isinstance(trajectory.get("tracked_series"), int),
+            "trajectory must carry green (bool) + tracked_series (int)",
+        )
+    else:
+        require(False, "trajectory must be a dict (the perf-history "
+                       "digest over committed artifacts)")
+
+    gates = payload.get("gates")
+    if isinstance(gates, dict):
+        for gk in ("residual_under_limit", "redone_matches_supervisor",
+                   "recovery_observed", "completed_exact",
+                   "trajectory_green"):
+            require(
+                isinstance(gates.get(gk), bool),
+                f"gates.{gk} must be a bool",
+            )
+    else:
+        require(False, "gates must be a dict")
+
+    if errors:
+        raise SchemaError("; ".join(errors))
+
+
+#: Ordered most-specific-first: the FIRST matching prefix wins, so a
+#: name matching two prefixes (``OBS_FLEET_*`` also matches ``OBS_*``)
+#: binds to its specific schema, and every specific kind — ``GOODPUT_*``
+#: included — dispatches here before falling through to nothing but the
+#: generic ``*_r*.json`` bench-line/percentile checks.
+_PREFIX_VALIDATORS = (
+    ("OBS_FLEET_", validate_obs_fleet_payload),
+    ("OBS_", validate_obs_payload),
+    ("SERVE_RESILIENCE_", validate_serve_resilience_payload),
+    ("SPEC_", validate_spec_payload),
+    ("CKPT_DURABLE_", validate_ckpt_durable_payload),
+    ("GOODPUT_", validate_goodput_payload),
+)
+
+
 def validate_artifact(path: str) -> Any:
     """Validate one committed artifact file; returns the parsed JSON.
 
@@ -570,33 +727,18 @@ def validate_artifact(path: str) -> Any:
     import os
 
     base = os.path.basename(path)
-    if base.startswith("OBS_FLEET_") and isinstance(data, dict):
-        # checked FIRST: OBS_FLEET_* also matches the OBS_ prefix, but it
-        # is a different contract (fleet merge, not decode attribution)
-        try:
-            validate_obs_fleet_payload(data)
-        except SchemaError as exc:
-            errors.append(str(exc))
-    elif base.startswith("OBS_") and isinstance(data, dict):
-        try:
-            validate_obs_payload(data)
-        except SchemaError as exc:
-            errors.append(str(exc))
-    if base.startswith("SERVE_RESILIENCE_") and isinstance(data, dict):
-        try:
-            validate_serve_resilience_payload(data)
-        except SchemaError as exc:
-            errors.append(str(exc))
-    if base.startswith("SPEC_") and isinstance(data, dict):
-        try:
-            validate_spec_payload(data)
-        except SchemaError as exc:
-            errors.append(str(exc))
-    if base.startswith("CKPT_DURABLE_") and isinstance(data, dict):
-        try:
-            validate_ckpt_durable_payload(data)
-        except SchemaError as exc:
-            errors.append(str(exc))
+    if isinstance(data, dict):
+        # ordered dispatch, first match wins (see _PREFIX_VALIDATORS:
+        # OBS_FLEET_ before the OBS_ prefix it also matches, and every
+        # specific kind before the generic fallback above is all that
+        # would check it)
+        for prefix, validator in _PREFIX_VALIDATORS:
+            if base.startswith(prefix):
+                try:
+                    validator(data)
+                except SchemaError as exc:
+                    errors.append(str(exc))
+                break
 
     if errors:
         raise SchemaError(f"{path}: " + "; ".join(errors))

@@ -72,6 +72,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from distributeddeeplearning_tpu.obs.fleet import fleet_latency
+from distributeddeeplearning_tpu.obs.goodput import post_warmup_tokens_per_sec
 from distributeddeeplearning_tpu.obs.recorder import get_recorder
 from distributeddeeplearning_tpu.obs.registry import (
     get_registry,
@@ -162,7 +163,14 @@ class FleetReport:
     requests: int
     generated_tokens: int
     wall_s: float
-    goodput_tokens_per_sec: float  # tokens of OK requests / wall
+    # tokens of OK requests over the POST-WARMUP window (wall minus the
+    # time to the fleet's first streamed token — spawn/import/compile),
+    # via the one shared helper obs/goodput.post_warmup_tokens_per_sec;
+    # dividing by the whole wall skewed cross-config comparisons the
+    # same way the pre-PR-8 tokens_per_sec did for ServeReport
+    goodput_tokens_per_sec: float
+    # the excluded warmup window itself (0.0 when no token ever streamed)
+    warmup_s: float
     completed_ok: int              # finish_reason in ("eos", "length")
     errors: int
     error_rate: float
@@ -1436,6 +1444,24 @@ class FleetRouter:
         errors = sum(1 for r in results if r.finish_reason == "error")
         generated = sum(len(r.tokens) for r in results)
         good_tokens = sum(len(r.tokens) for r in ok)
+        # post-warmup window: goodput_tokens_per_sec used to divide by
+        # the WHOLE wall — replica spawn, jax import and XLA compile
+        # included — the same skew class ServeReport.decode_tokens_per_sec
+        # fixed for the single-engine report.  The warmup boundary is the
+        # router observing the fleet's FIRST streamed token (engines are
+        # built and compiled from then on); the shared helper in
+        # obs/goodput.py is the one definition of the windowed rate.
+        first_token = min(
+            (
+                fl.first_token_at for fl in flights.values()
+                if fl.first_token_at is not None
+            ),
+            default=None,
+        )
+        warmup_s = (
+            max(first_token - t_start, 0.0) if first_token is not None
+            else 0.0
+        )
         tpot = [
             (r.total_s - r.ttft_s) / (len(r.tokens) - 1)
             for r in ok
@@ -1456,9 +1482,10 @@ class FleetRouter:
             requests=len(flights),
             generated_tokens=generated,
             wall_s=round(wall, 4),
-            goodput_tokens_per_sec=(
-                round(good_tokens / wall, 2) if wall > 0 else 0.0
+            goodput_tokens_per_sec=post_warmup_tokens_per_sec(
+                good_tokens, wall, warmup_s
             ),
+            warmup_s=round(warmup_s, 4),
             completed_ok=len(ok),
             errors=errors,
             error_rate=round(errors / len(flights), 4) if flights else 0.0,

@@ -57,6 +57,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from distributeddeeplearning_tpu.obs import goodput as _goodput
 from distributeddeeplearning_tpu.obs.recorder import get_recorder
 from distributeddeeplearning_tpu.obs.registry import get_registry
 from distributeddeeplearning_tpu.obs.trace import get_tracer
@@ -475,6 +476,12 @@ class Checkpointer:
             # manifest except this step's is ready to finalize now
             self._finalize_manifests(exclude_step=step)
         self.save_wall_s += time.perf_counter() - t0
+        # goodput detail: the trainer's marks already charge this wall to
+        # checkpoint_blocking — the note splits it save-join vs wait-drain
+        # for the ledger's notes block (never double-counted in the sum)
+        _goodput.get_ledger().note(
+            "ckpt_save_block_s", time.perf_counter() - t0
+        )
         if saved:
             logger.info("checkpoint saved at step %d -> %s", step, self.directory)
         return saved
@@ -564,11 +571,17 @@ class Checkpointer:
             faults_mod.get_plan().maybe_io_error("checkpoint.wait")
             self._mgr.wait_until_finished()
 
+        t0 = time.perf_counter()
         retry_call(
             _wait, retries=2, base_delay=0.2, max_delay=2.0,
             description="checkpoint wait", deadline_s=deadline_s,
         )
         self._finalize_manifests()
+        # goodput detail note (see save(): categories come from the
+        # trainer's marks, this is the save-join vs wait-drain split)
+        _goodput.get_ledger().note(
+            "ckpt_wait_block_s", time.perf_counter() - t0
+        )
 
     # -- restore-eligibility ----------------------------------------------
 

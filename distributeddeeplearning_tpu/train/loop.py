@@ -27,6 +27,8 @@ from typing import Any, Callable, Dict, Iterator, Optional
 import jax
 import numpy as np
 
+from distributeddeeplearning_tpu.obs import goodput as goodput_mod
+from distributeddeeplearning_tpu.obs.goodput import GoodputLedger
 from distributeddeeplearning_tpu.obs.registry import get_registry
 from distributeddeeplearning_tpu.obs.trace import get_tracer
 from distributeddeeplearning_tpu.parallel.distributed import is_primary
@@ -229,6 +231,14 @@ class TrainerConfig:
     # Writes go through the retry layer + DDLT_FAULTS io_error hook, same
     # as the metrics log; append-only, so rows survive restarts.
     obs_metrics_path: Optional[str] = None
+    # Goodput ledger (obs/goodput.py): classify 100% of the fit's wall
+    # into named categories (productive/redone steps, compile, data
+    # wait, checkpoint blocking, eval, recovery, other) and append one
+    # restart-durable JSONL segment per fit incarnation here — the
+    # stitched file is the GOODPUT artifact's evidence.  None (the
+    # default) = disabled: the hot-loop mark calls reduce to one
+    # attribute check (lint-pinned zero-sync either way).
+    goodput_path: Optional[str] = None
 
 
 def _drain_bounded(batches: Iterator, limit, cap: int) -> list:
@@ -268,6 +278,12 @@ class FitResult:
 
 
 class Trainer:
+    # class-level fallback so a partially-constructed Trainer (tests
+    # drive isolated paths via ``Trainer.__new__``) still has inert
+    # ledger marks; __init__ always overrides with the configured one
+    goodput = GoodputLedger(enabled=False)
+    _flops_probed = True
+
     def __init__(
         self,
         mesh,
@@ -289,6 +305,10 @@ class Trainer:
             if config.checkpoint_dir
             else None
         )
+        # wall-clock goodput accounting (no-op marks unless goodput_path
+        # is set); one ledger per Trainer, one SEGMENT per fit attempt
+        self.goodput = GoodputLedger(config.goodput_path)
+        self._flops_probed = False
 
     def fit(
         self,
@@ -351,14 +371,31 @@ class Trainer:
         )
 
         rollbacks = 0
+        # the ledger becomes the PROCESS ledger for the fit so deep
+        # layers (Checkpointer save/wait joins) can attach their detail
+        # notes without plumbing; restored in the outer finally
+        prev_ledger = (
+            goodput_mod.set_ledger(self.goodput)
+            if self.goodput.enabled else None
+        )
         try:
             while True:
+                # one ledger segment per fit attempt: begin() re-reads
+                # prior segments so redone-step classification survives
+                # both in-process rollbacks and cross-process restarts
+                self.goodput.begin()
                 start_epoch = 0
                 start_step_in_epoch = 0
                 restored_step = None
                 if self.checkpointer is not None and cfg.resume:
                     state, restored_step = self.checkpointer.restore(state)
+                    if restored_step is None:
+                        # resumed nothing: a NEW run lineage — a reused
+                        # ledger file's earlier segments must not mark
+                        # this run's steps redone (obs/goodput.py)
+                        self.goodput.fresh_start()
                     if restored_step is not None:
+                        self.goodput.set_resumed_step(int(restored_step))
                         start_epoch = int(restored_step) // cfg.steps_per_epoch
                         start_step_in_epoch = (
                             int(restored_step) % cfg.steps_per_epoch
@@ -370,6 +407,10 @@ class Trainer:
                                 restored_step, start_epoch,
                                 start_step_in_epoch,
                             )
+                else:
+                    # no checkpointer / resume disabled: by construction
+                    # nothing was resumed — new run lineage
+                    self.goodput.fresh_start()
                 batches = (
                     factory(int(restored_step or 0))
                     if factory is not None
@@ -390,6 +431,7 @@ class Trainer:
                         batches, self.mesh, size=cfg.prefetch
                     )
 
+                attempt_reason = "completed"
                 try:
                     state, result = self._fit_inner(
                         state, batches, eval_batches_factory, start_epoch,
@@ -399,6 +441,11 @@ class Trainer:
                     result.rollbacks = rollbacks
                     return state, result
                 except AnomalyError as exc:
+                    # the finally below cannot see a HANDLED exception
+                    # (Python clears it once this block completes), so
+                    # the rolled-back attempt's segment reason is stamped
+                    # here, not from sys.exc_info()
+                    attempt_reason = type(exc).__name__
                     if watchdog is not None:
                         # the rollback restore below is storage-bound, not
                         # hot-loop progress
@@ -450,11 +497,57 @@ class Trainer:
                         # at the last checkpoint_every_steps boundary and
                         # losing it.
                         self.checkpointer.wait()
+                        self.goodput.mark("checkpoint_blocking")
+                    # close the attempt's ledger segment whatever happened
+                    # — a PreemptionError unwinding here still appends its
+                    # segment, which is what makes the ledger restart-
+                    # durable (stitching charges the gap to recovery)
+                    import sys as _sys
+
+                    exc_type = _sys.exc_info()[0]
+                    self.goodput.end(
+                        reason=(
+                            attempt_reason if exc_type is None
+                            else exc_type.__name__
+                        )
+                    )
         finally:
             if watchdog is not None:
                 watchdog.stop()
             if guard is not None:
                 guard.uninstall()
+            if prev_ledger is not None:
+                goodput_mod.set_ledger(prev_ledger)
+
+    def _maybe_measure_flops(self, state, batch) -> None:
+        """Best-effort MFU numerator: XLA's own cost model for ONE train
+        step (``utils/hardware.step_flops``), fed into the goodput
+        ledger.  Only attempted when the ledger is on AND the chip has a
+        known peak — off-TPU the MFU column is omitted anyway, so the
+        AOT-lowering cost (a second trace) is never paid on the CPU test
+        mesh.  The probe stops at ``.lower()`` — the UNOPTIMIZED cost
+        analysis, which is what the model-FLOPs numerator wants anyway
+        (PaLM MFU counts model FLOPs, not remat re-execution) — because
+        ``.lower().compile()`` would run a SECOND full XLA compile that
+        the jit dispatch cache never sees, doubling large-model startup.
+        Any failure (a step builder without ``.lower``, a backend
+        without a cost model) just leaves MFU omitted.
+        """
+        if self._flops_probed or not self.goodput.enabled:
+            return
+        self._flops_probed = True
+        try:
+            from distributeddeeplearning_tpu.utils.hardware import (
+                peak_bf16_flops,
+                step_flops,
+            )
+
+            if peak_bf16_flops() is None:
+                return
+            lowered = self.train_step.lower(state, batch)
+            self.goodput.set_flops_per_step(step_flops(lowered))
+        except Exception:  # MFU is an optional column, never a crash
+            pass
 
     def _emergency_stop(self, step: int, state, watchdog, guard=None) -> None:
         """Preemption noticed at a step boundary: synchronous emergency
@@ -474,6 +567,7 @@ class Trainer:
             # — so the window's REMAINDER (re-read before each phase; save
             # may have consumed most of it) deadline-bounds the retry
             # backoff inside both (retry_call(deadline_s=...)).
+            self.goodput.mark("other")
             with get_tracer().span(
                 "train/emergency_checkpoint", cat="resilience", step=step
             ):
@@ -488,6 +582,7 @@ class Trainer:
                         guard.remaining_grace() if guard is not None else None
                     ),
                 )
+            self.goodput.mark("checkpoint_blocking")
             logger.warning("emergency checkpoint at step %d complete", step)
         raise PreemptionError(
             f"preempted at step {step} (emergency checkpoint "
@@ -506,6 +601,10 @@ class Trainer:
         # events.  Disabled (the default) = shared no-op spans, no clock
         # reads — the hot-loop lint pins the loop body sync-free either way.
         trace = get_tracer()
+        # everything since the segment's begin() — checkpoint restore,
+        # stream construction, prefetch spin-up — is restart/recovery
+        # work, not training
+        self.goodput.mark("recovery")
         tracker = ExamplesPerSecondTracker(
             global_batch_size=cfg.global_batch_size,
             every_n_steps=cfg.log_every,
@@ -551,10 +650,14 @@ class Trainer:
                     profile_active, profile_pending = True, False
                 with trace.span("train/data_wait", step=true_step):
                     host_batch = next(train_batches)
+                self.goodput.mark("data_wait")
                 if plan:
                     host_batch = plan.poison_batch(true_step, host_batch)
                 with trace.span("train/step", step=true_step):
                     batch = shard_batch(self.mesh, host_batch)
+                    if global_step == 0:
+                        # MFU numerator (no-op off-TPU / ledger-disabled)
+                        self._maybe_measure_flops(state, batch)
                     state, metrics = self.train_step(state, batch)
                 anomalous = False
                 if detector is not None:
@@ -590,6 +693,10 @@ class Trainer:
                     acc = metrics if acc is None else _acc_add(acc, metrics)
                 if (step_i + 1) % cfg.log_every == 0:
                     jax.block_until_ready(acc)
+                # charge the step's wall (dispatch + the detector/log-
+                # boundary syncs above) to compile / step_redone /
+                # step_productive — the ledger classifies (obs/goodput.py)
+                self.goodput.mark_step(true_step)
                 tracker.after_step()
                 if watchdog is not None:
                     watchdog.tick(true_step)
@@ -618,6 +725,7 @@ class Trainer:
                     # serialize/write happens on orbax's background thread.
                     with trace.span("train/checkpoint", step=true_step):
                         self.checkpointer.save(true_step, state)
+                    self.goodput.mark("checkpoint_blocking")
                 if guard is not None:
                     if plan:
                         plan.maybe_preempt(true_step, guard)
@@ -657,12 +765,16 @@ class Trainer:
                     {k: round(v, 4) for k, v in train_metrics.items()},
                 )
             self.tb.scalars("train", train_metrics, epoch)
+            # epoch rollup so far (metric readback, logs, TB) is loop
+            # bookkeeping, not training
+            self.goodput.mark("other")
 
             if self.eval_step is not None and eval_batches_factory is not None:
                 with trace.span("train/eval", epoch=epoch + 1):
                     eval_metrics = self.evaluate(
                         state, eval_batches_factory()
                     )
+                self.goodput.mark("eval")
                 if is_primary():
                     logger.info(
                         "epoch %d validation: %s",
@@ -707,12 +819,14 @@ class Trainer:
                 reg.write_snapshot(cfg.obs_metrics_path, epoch=epoch + 1)
 
             if self.checkpointer is not None:
+                self.goodput.mark("other")
                 with trace.span(
                     "train/checkpoint", step=(epoch + 1) * cfg.steps_per_epoch
                 ):
                     self.checkpointer.save(
                         (epoch + 1) * cfg.steps_per_epoch, state
                     )
+                self.goodput.mark("checkpoint_blocking")
 
         wall = time.monotonic() - train_t0
         self.tb.flush()

@@ -384,6 +384,7 @@ def supervise(
     max_restarts: int = 0,
     restart_on: Tuple[type, ...] = (RestartableError,),
     on_restart: Optional[Callable[[int, BaseException], None]] = None,
+    ledger_path: Optional[str] = None,
 ):
     """In-process restart loop: call ``fn(attempt)``, restarting on
     restartable failures up to ``max_restarts`` times.
@@ -393,6 +394,13 @@ def supervise(
     the control plane's resubmit loop.  ``fn`` must be restartable by
     construction — i.e. resume from its own checkpoints — or the loop just
     re-runs the failure.
+
+    ``ledger_path`` is the goodput ledger's JSONL file (``obs/goodput.py``):
+    when set, every restart appends a ``restart`` marker row from the
+    SUPERVISOR's side — so the stitched ledger can cross-check that
+    segments and restarts interleave (a segment the dying attempt failed
+    to write is detectable, not silent) and charge the restart gap to the
+    ``recovery`` category.
 
     Returns ``(result, restarts_used)``.  The final failure propagates.
     """
@@ -408,5 +416,15 @@ def supervise(
                 "restartable failure (%s: %s) — restart %d/%d from latest "
                 "checkpoint", type(exc).__name__, exc, restarts, max_restarts,
             )
+            if ledger_path:
+                from distributeddeeplearning_tpu.obs import goodput
+
+                goodput.append_row(ledger_path, {
+                    "kind": "restart",
+                    "ts": time.time(),
+                    "attempt": restarts,
+                    "error": type(exc).__name__,
+                    "step": getattr(exc, "step", None),
+                })
             if on_restart is not None:
                 on_restart(restarts, exc)

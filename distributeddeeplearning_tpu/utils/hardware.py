@@ -35,17 +35,63 @@ _PEAK_BF16_FLOPS = [
 def peak_bf16_flops(device: Optional[jax.Device] = None) -> Optional[float]:
     """Peak dense bf16 FLOP/s for ``device`` (default: first visible device).
 
-    Returns None when the device kind is unrecognized (e.g. the CPU backend
-    used by the virtual test mesh) — callers should then omit MFU rather
-    than report a made-up number.
+    Returns None — NEVER raises — when the device kind is unrecognized
+    (the CPU backend used by the virtual test mesh reports kinds like
+    ``"cpu"``) or when the backend cannot even report a kind: callers
+    must then omit MFU rather than report a made-up number.  An
+    exception here would turn "unknown chip" into a crashed benchmark,
+    which is strictly worse than a missing utilization column.
     """
-    if device is None:
-        device = jax.devices()[0]
-    kind = device.device_kind.lower()
+    try:
+        if device is None:
+            device = jax.devices()[0]
+        kind = device.device_kind.lower()
+    except Exception:
+        return None  # no devices / kind-less backend: MFU omitted
     for key, peak in _PEAK_BF16_FLOPS:
         if key in kind:
             return peak
     return None
+
+
+def mfu(
+    flops_per_step: float,
+    steps: int,
+    wall_s: float,
+    *,
+    device: Optional[jax.Device] = None,
+    n_chips: Optional[int] = None,
+) -> Optional[float]:
+    """Model FLOPs Utilization, as defined in the PaLM paper's appendix:
+    the model's *observed* FLOP throughput as a fraction of the
+    hardware's peak.  The formula actually computed here::
+
+        MFU = (flops_per_step × steps / wall_s) / (peak_bf16_flops × n_chips)
+
+    where ``flops_per_step`` is the MODEL FLOPs of one train step (XLA's
+    own cost model via :func:`step_flops`, or an analytic count — NOT
+    hardware FLOPs: rematerialization re-executes work without raising
+    MFU), ``wall_s`` is the whole window being scored (a run-level MFU
+    divides by total wall, overheads included — that is the point), and
+    ``n_chips`` defaults to every visible device.
+
+    Returns None when the chip's peak is unknown (CPU / virtual test
+    mesh — :func:`peak_bf16_flops` returns None there) or the inputs are
+    degenerate; callers omit the MFU column rather than fabricate one.
+    """
+    if flops_per_step <= 0 or steps <= 0 or wall_s <= 0:
+        return None
+    peak = peak_bf16_flops(device)
+    if peak is None:
+        return None
+    if n_chips is None:
+        try:
+            n_chips = jax.device_count()
+        except Exception:
+            return None
+    if n_chips <= 0:
+        return None
+    return (flops_per_step * steps / wall_s) / (peak * n_chips)
 
 
 def enable_compilation_cache(min_compile_time_secs: int = 1) -> None:
@@ -69,11 +115,13 @@ def enable_compilation_cache(min_compile_time_secs: int = 1) -> None:
 
 
 def step_flops(compiled) -> Optional[float]:
-    """Total FLOPs of one execution of a compiled XLA program.
+    """Total FLOPs of one execution of an XLA program.
 
-    Reads XLA's own cost model via ``Compiled.cost_analysis()`` — the same
-    count the profiler uses — so it automatically tracks rematerialization
-    and fusion decisions instead of trusting an analytic formula.
+    Reads XLA's own cost model via ``cost_analysis()`` — the same count
+    the profiler uses.  Accepts a ``Compiled`` (post-optimization: tracks
+    remat/fusion decisions) or a ``Lowered`` stage (pre-optimization
+    model FLOPs — the MFU numerator, obtainable WITHOUT paying a second
+    compile; the goodput ledger probes this form).
     """
     try:
         analysis = compiled.cost_analysis()

@@ -186,6 +186,7 @@ def main(
     checkpoint_every_steps: Optional[int] = None,  # mid-epoch save cadence
     profile_dir: Optional[str] = None,  # jax.profiler trace of steps 10-20
     metrics_path: Optional[str] = None,  # per-epoch JSONL rows (run.log_row)
+    goodput_path: Optional[str] = None,  # goodput-ledger JSONL (obs/goodput.py)
     aux_logits: bool = False,  # InceptionV3 aux head, loss weighted 0.4
     num_slices: int = 1,  # multi-slice (DCN) data parallelism
     # -- explicit gradient comms (parallel/comms.py; step.py docstrings) --
@@ -320,6 +321,7 @@ def main(
             resume=resume,
             profile_dir=profile_dir,
             metrics_path=metrics_path,
+            goodput_path=goodput_path,
             anomaly_max_consecutive=anomaly_max_consecutive,
             anomaly_rollback=anomaly_rollback,
             step_deadline_s=step_deadline_s,

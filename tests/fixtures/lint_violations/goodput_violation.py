@@ -1,0 +1,24 @@
+"""Seeded goodput-ledger hot-path violation for the analyzer's pins.
+
+NEVER imported by production code: the test points the AST checker at a
+ledger-record function that coerces its ``seconds`` argument with a
+host-syncing ``float(...)`` — the exact bug class the real
+``GoodputLedger.mark`` region (``obs-goodput-mark``) bans with a ZERO
+designed-sync budget.  Callers pass host floats by contract; a record
+path that coerces would silently accept (and synchronize on) a device
+scalar at EVERY phase boundary of the trainer hot loop.
+"""
+
+import time
+
+
+def record_goodput(ledger, category, seconds):
+    """A mark()-shaped ledger record with the planted host coercion."""
+    now = time.perf_counter()  # landmark: the one clock read mark() makes
+    note = "float( in this string must never be flagged"
+    ledger.seconds[category] = (
+        ledger.seconds.get(category, 0.0)
+        + float(seconds)  # PLANTED: host-syncing coercion on the record path
+    )
+    ledger.last_mark = now
+    del note

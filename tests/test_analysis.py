@@ -158,6 +158,37 @@ class TestHostSyncChecker:
         assert len(syncs) == 1
         assert "markers are not honored" in syncs[0].message
 
+    def test_goodput_record_float_coercion_is_caught(self):
+        """The goodput-ledger seeded fixture: a ledger category recorded
+        via a host-syncing ``float(...)`` on the mark()-shaped record
+        path — the exact class the real ``obs-goodput-mark`` region bans
+        with its zero budget — is caught at file:line (and the decoy
+        ``float(`` inside the string is not)."""
+        path = FIXTURES / "goodput_violation.py"
+        region = _fixture_region(
+            qualname="record_goodput",
+            locator=None,  # the whole record function is the region
+            landmarks=("time.perf_counter()",),
+            sync_budget=0,
+        )
+        findings = host_sync.check_region(region, path=str(path))
+        syncs = [f for f in findings if f.checker == "host-sync"]
+        assert [f.line for f in syncs] == [
+            _line_of(path, "float(seconds)")
+        ], format_findings(findings)
+        assert _line_of(path, "in this string") not in {
+            f.line for f in findings
+        }
+        # the live-tree region this fixture mirrors is registered with a
+        # zero budget — and the real record path stays clean under it
+        from distributeddeeplearning_tpu.analysis.regions import get_region
+
+        real = get_region("obs-goodput-mark")
+        assert real.sync_budget == 0
+        assert not host_sync.check_region(real), format_findings(
+            host_sync.check_region(real)
+        )
+
 
 # --------------------------------------------------------------------------
 # fault-coverage cross-check

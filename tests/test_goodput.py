@@ -1,0 +1,751 @@
+"""Goodput ledger + perf-trajectory tracker (ISSUE 14).
+
+Covers:
+
+- the mark-based ledger partitioning 100% of wall by construction, with
+  the compile / step_redone / step_productive classification matching
+  the supervisor's redone-steps accounting EXACTLY across restarts
+  (preemption with exact resume AND anomaly abort with an older
+  checkpoint — the two restart flavors charge differently);
+- restart durability: per-incarnation JSONL segments appended through
+  the retry layer (surviving an injected ``io_error``), stitched with
+  the between-incarnation gap charged to ``recovery``, the residual
+  gate catching lost time;
+- MFU plumbing: ``utils/hardware.peak_bf16_flops`` returning None (not
+  raising) for unknown chips including the virtual test mesh's device
+  kind, and the documented ``mfu`` formula;
+- the one post-warmup tokens/sec helper shared by the fleet report;
+- the trajectory tracker: committed-artifact timeline, sparklines, the
+  per-metric tolerance gate passing over real history and failing (rc
+  1) on a fixture artifact with an injected regression, list paths
+  excluded as positional;
+- the GOODPUT schema: acceptance, the categories-don't-sum rejection,
+  and the ordered most-specific-first prefix dispatch.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.obs import goodput
+from distributeddeeplearning_tpu.obs import history
+from distributeddeeplearning_tpu.obs.goodput import (
+    CATEGORIES,
+    GoodputLedger,
+    post_warmup_tokens_per_sec,
+)
+from distributeddeeplearning_tpu.obs.schema import (
+    SchemaError,
+    validate_artifact,
+    validate_goodput_payload,
+)
+from distributeddeeplearning_tpu.utils import faults
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# --------------------------------------------------------------------------
+# ledger unit behavior
+# --------------------------------------------------------------------------
+
+
+def test_marks_partition_the_wall(tmp_path):
+    """Every second between begin() and end() lands in exactly one
+    category — the mark design makes 100% coverage structural."""
+    path = str(tmp_path / "gp.jsonl")
+    ledger = GoodputLedger(path)
+    ledger.begin()
+    time.sleep(0.01)
+    ledger.mark("data_wait")
+    time.sleep(0.02)
+    ledger.mark_step(1)          # first step -> compile
+    time.sleep(0.01)
+    ledger.mark_step(2)          # -> step_productive
+    seg = ledger.end()
+    assert seg["counts"] == {"steps": 2, "steps_redone": 0}
+    total = sum(seg["seconds"].values())
+    assert abs(total - seg["duration_s"]) < 1e-6
+    assert seg["seconds"]["compile"] >= 0.02
+    assert seg["seconds"]["data_wait"] >= 0.01
+    assert seg["seconds"]["step_productive"] >= 0.01
+    # the row landed on disk
+    rows = goodput.read_rows(path)
+    assert len(rows) == 1 and rows[0]["kind"] == "segment"
+
+
+def test_mark_step_redone_classification(tmp_path):
+    """A later incarnation re-executing steps an earlier one completed
+    counts them as redone — including a redone FIRST step, whose seconds
+    go to compile but whose count stays in steps_redone (the supervisor
+    counts it; the ledger must agree)."""
+    path = str(tmp_path / "gp.jsonl")
+    first = GoodputLedger(path)
+    first.begin()
+    for s in (1, 2, 3, 4, 5):
+        first.mark_step(s)
+    first.end()
+
+    second = GoodputLedger(path)
+    second.begin(resumed_step=3)
+    assert second._redone_until == 5
+    for s in (4, 5, 6, 7):
+        second.mark_step(s)
+    seg = second.end()
+    # steps 4 and 5 are redone (<= 5); step 4 is also the segment's
+    # compile payer — counted redone, charged compile
+    assert seg["counts"] == {"steps": 4, "steps_redone": 2}
+    assert seg["seconds"]["compile"] > 0.0
+    merged = goodput.stitch(path)
+    assert merged["counts"] == {"steps": 9, "steps_redone": 2}
+    assert merged["last_step"] == 7
+
+
+def test_reused_ledger_path_starts_new_run_lineage(tmp_path):
+    """A fresh run pointed at a REUSED ledger file must not classify its
+    steps as redone against the stale segments, and stitch must not
+    charge the gap between unrelated runs to recovery — fresh_start()
+    bumps the run lineage and stitch keeps only the newest run."""
+    path = str(tmp_path / "gp.jsonl")
+    old = GoodputLedger(path)
+    old.begin()
+    for s in (1, 2, 3):
+        old.mark_step(s)
+    old.end()
+
+    new = GoodputLedger(path)
+    new.begin()
+    new.fresh_start()          # the Trainer's resumed-nothing signal
+    for s in (1, 2):
+        new.mark_step(s)
+    seg = new.end()
+    assert seg["run"] == 1
+    assert seg["counts"] == {"steps": 2, "steps_redone": 0}
+    merged = goodput.stitch(path)
+    # only the new run's segment is stitched: no phantom recovery gap,
+    # no stale steps diluting the counts
+    assert merged["segments"] == 1 and merged["runs_in_file"] == 2
+    assert merged["counts"]["steps"] == 2
+    assert merged["seconds"]["recovery"] == 0.0
+    assert merged["total_wall_s"] == pytest.approx(
+        seg["duration_s"], abs=1e-6
+    )
+
+
+def test_disabled_ledger_is_inert(tmp_path):
+    ledger = GoodputLedger(None)
+    assert not ledger.enabled
+    ledger.begin()
+    ledger.mark("data_wait")
+    ledger.mark_step(1)
+    ledger.note("x", 1.0)
+    assert ledger.end() is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_segment_append_survives_injected_io_error(monkeypatch, tmp_path):
+    """The JSONL append rides retry_call + the DDLT_FAULTS io_error hook
+    (the metrics/checkpoint contract): one injected failure, row lands."""
+    monkeypatch.setenv(faults.ENV_VAR, "io_error@1")
+    faults.reset()
+    path = str(tmp_path / "gp.jsonl")
+    ledger = GoodputLedger(path)
+    ledger.begin()
+    ledger.mark_step(1)
+    ledger.end()
+    assert len(goodput.read_rows(path)) == 1
+
+
+def test_stitch_charges_restart_gap_to_recovery():
+    base = time.time()
+
+    def seg(i, start, dur, last_step, **seconds):
+        body = {c: 0.0 for c in CATEGORIES}
+        body.update(seconds)
+        # stitch reads seconds/counts/walls only
+        return {
+            "kind": "segment", "incarnation": i,
+            "wall_start": base + start, "wall_end": base + start + dur,
+            "duration_s": dur, "seconds": body,
+            "counts": {"steps": 1, "steps_redone": 0},
+            "last_step": last_step,
+        }
+
+    rows = [
+        seg(0, 0.0, 10.0, 5, step_productive=10.0),
+        {"kind": "restart", "ts": base + 10.5, "attempt": 1,
+         "error": "PreemptionError", "step": 5},
+        seg(1, 12.0, 8.0, 9, step_productive=7.0, recovery=1.0),
+    ]
+    merged = goodput.stitch(rows)
+    assert merged["segments"] == 2 and merged["restarts"] == 1
+    # in-segment recovery (1.0) + the 2.0s inter-incarnation gap
+    assert merged["seconds"]["recovery"] == pytest.approx(3.0)
+    assert merged["total_wall_s"] == pytest.approx(20.0)
+    summary = goodput.summarize_ledger(merged)
+    assert summary["goodput_fraction"] == pytest.approx(17.0 / 20.0)
+    assert summary["residual_under_limit"]
+    assert summary["counts"]["segments"] == 2
+
+
+def test_residual_gate_catches_lost_time():
+    """A merged ledger whose categories do NOT cover the wall (a lost
+    segment, marks missing) fails the residual gate instead of reporting
+    optimistic goodput."""
+    base = time.time()
+    merged = goodput.stitch([{
+        "kind": "segment", "incarnation": 0,
+        "wall_start": base, "wall_end": base + 10.0, "duration_s": 10.0,
+        # only 5 of the 10 seconds accounted
+        "seconds": {"step_productive": 5.0},
+        "counts": {"steps": 1, "steps_redone": 0}, "last_step": 1,
+    }])
+    summary = goodput.summarize_ledger(merged)
+    assert summary["unaccounted_pct"] == pytest.approx(50.0)
+    assert not summary["residual_under_limit"]
+
+
+# --------------------------------------------------------------------------
+# MFU / hardware satellites
+# --------------------------------------------------------------------------
+
+
+def test_peak_flops_unknown_chip_returns_none_not_raise():
+    from distributeddeeplearning_tpu.utils.hardware import peak_bf16_flops
+
+    import jax
+
+    # the virtual test mesh's fake device kind (CPU backend) is unknown
+    assert peak_bf16_flops(jax.devices()[0]) is None
+    # an exotic backend whose device_kind ACCESS raises must still
+    # answer None (MFU omitted), never propagate
+    class _Hostile:
+        @property
+        def device_kind(self):
+            raise RuntimeError("no kind on this backend")
+
+    assert peak_bf16_flops(_Hostile()) is None
+
+
+def test_mfu_formula_and_omission():
+    from distributeddeeplearning_tpu.utils.hardware import mfu
+
+    v4 = SimpleNamespace(device_kind="TPU v4")  # peak 275e12
+    # (275e12 * 5 / 10) / (275e12 * 1) = 0.5 — the documented formula
+    assert mfu(275e12, 5, 10.0, device=v4, n_chips=1) == pytest.approx(0.5)
+    # chips divide the peak
+    assert mfu(275e12, 5, 10.0, device=v4, n_chips=2) == pytest.approx(0.25)
+    # unknown chip / degenerate inputs omit, never raise
+    assert mfu(275e12, 5, 10.0, device=SimpleNamespace(device_kind="cpu"),
+               n_chips=1) is None
+    assert mfu(0.0, 5, 10.0, device=v4, n_chips=1) is None
+    assert mfu(275e12, 0, 10.0, device=v4, n_chips=1) is None
+
+
+def test_summarize_ledger_omits_mfu_off_tpu():
+    base = time.time()
+    merged = goodput.stitch([{
+        "kind": "segment", "incarnation": 0,
+        "wall_start": base, "wall_end": base + 1.0, "duration_s": 1.0,
+        "seconds": {"step_productive": 1.0},
+        "counts": {"steps": 4, "steps_redone": 0}, "last_step": 4,
+        "flops_per_step": 1e9,
+    }])
+    summary = goodput.summarize_ledger(merged)  # CPU: peak unknown
+    assert summary["mfu"] is None
+    assert "mfu_omitted_reason" in summary
+
+
+# --------------------------------------------------------------------------
+# the shared post-warmup tokens/sec helper (FleetReport satellite)
+# --------------------------------------------------------------------------
+
+
+def test_post_warmup_tokens_per_sec_excludes_warmup():
+    # 100 tokens over 20s of which 10s was spawn/compile -> 10 tok/s,
+    # not the 5 tok/s the whole-wall division used to report
+    assert post_warmup_tokens_per_sec(100, 20.0, 10.0) == 10.0
+    assert post_warmup_tokens_per_sec(100, 20.0, 0.0) == 5.0
+    # degenerate windows fall back to the whole wall, never divide by ~0
+    assert post_warmup_tokens_per_sec(100, 20.0, 20.0) == 5.0
+    assert post_warmup_tokens_per_sec(100, 20.0, 999.0) == 5.0
+    assert post_warmup_tokens_per_sec(100, 0.0, 0.0) == 0.0
+
+
+def test_fleet_report_carries_post_warmup_goodput_fields():
+    """The fleet report's goodput rate is the post-warmup definition:
+    the warmup window travels with it so readers can reconstruct the
+    whole-wall number."""
+    from distributeddeeplearning_tpu.serve.fleet import FleetRouter, FleetReport
+
+    names = {f.name for f in dataclasses.fields(FleetReport)}
+    assert {"goodput_tokens_per_sec", "warmup_s"} <= names
+    # the router routes through the ONE shared helper (no forked math)
+    import inspect
+
+    src = inspect.getsource(FleetRouter.serve)
+    assert "post_warmup_tokens_per_sec(" in src
+
+
+# --------------------------------------------------------------------------
+# restart-durable stitching against the REAL trainer + supervisor
+# --------------------------------------------------------------------------
+
+GLOBAL_BATCH = 16
+IMG = (4, 4, 3)
+NCLS = 5
+
+
+@pytest.fixture(scope="module")
+def tiny_parts():
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from flax import linen as nn
+
+    from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh
+    from distributeddeeplearning_tpu.train.state import (
+        create_train_state,
+        sgd_momentum,
+    )
+    from distributeddeeplearning_tpu.train.step import build_train_step
+
+    class _Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(NCLS)(x.reshape((x.shape[0], -1)))
+
+    mesh = create_mesh(MeshSpec())
+    model = _Tiny()
+    tx = sgd_momentum(optax.constant_schedule(0.05))
+
+    def mk_state():
+        return create_train_state(jax.random.key(0), model, (8, *IMG), tx)
+
+    step = build_train_step(
+        mesh, mk_state(), compute_dtype=jnp.float32, skip_nonfinite=True
+    )
+    return mesh, mk_state, step
+
+
+def _factory(start_step: int):
+    def gen():
+        i = start_step
+        while True:
+            rng = np.random.default_rng(1000 + i)
+            yield {
+                "image": rng.standard_normal(
+                    (GLOBAL_BATCH, *IMG)
+                ).astype(np.float32),
+                "label": rng.integers(0, NCLS, (GLOBAL_BATCH,)).astype(
+                    np.int32
+                ),
+            }
+            i += 1
+
+    return gen()
+
+
+def _supervised_run(mesh, mk_state, step, tmp_path, monkeypatch, spec, *,
+                    anomaly_max=3, epochs=2, spe=4, every=2,
+                    max_restarts=1):
+    """The ``ddlt train --max-restarts`` shape, in-process: supervise()
+    around Trainer.fit with the cli's exact redone-steps accounting."""
+    from distributeddeeplearning_tpu.train import resilience
+    from distributeddeeplearning_tpu.train.checkpoint import (
+        latest_verified_step_in_dir,
+    )
+    from distributeddeeplearning_tpu.train.loop import Trainer, TrainerConfig
+
+    ckpt = str(tmp_path / "ck")
+    gp = str(tmp_path / "gp.jsonl")
+    monkeypatch.setenv(faults.ENV_VAR, spec)
+    faults.reset()
+    cfg = TrainerConfig(
+        epochs=epochs, steps_per_epoch=spe, global_batch_size=GLOBAL_BATCH,
+        prefetch=0, checkpoint_dir=ckpt, checkpoint_every_steps=every,
+        anomaly_max_consecutive=anomaly_max, goodput_path=gp,
+    )
+
+    def attempt(i):
+        return Trainer(mesh, step, config=cfg).fit(mk_state(), _factory)
+
+    redone = {"steps": 0}
+
+    def on_restart(i, exc):
+        # the cli supervisor's accounting, verbatim (cli/main.py)
+        at = getattr(exc, "step", None)
+        if at is None:
+            return
+        done = at if isinstance(exc, resilience.PreemptionError) else at - 1
+        redone["steps"] += max(
+            done - (latest_verified_step_in_dir(ckpt) or 0), 0
+        )
+
+    (state, fit), restarts = resilience.supervise(
+        attempt, max_restarts=max_restarts, on_restart=on_restart,
+        ledger_path=gp,
+    )
+    return state, fit, restarts, redone["steps"], gp
+
+
+@pytest.mark.timeout(120)
+def test_preempt_restart_produces_one_stitched_ledger(
+    tiny_parts, tmp_path, monkeypatch
+):
+    """ISSUE satellite: a ``DDLT_FAULTS preempt@N`` + max-restarts-1 run
+    produces ONE merged ledger whose recovery and step_redone categories
+    match the supervisor's redone-steps accounting exactly, and whose
+    category sum covers total wall within the residual gate."""
+    mesh, mk_state, step = tiny_parts
+    state, fit, restarts, sup_redone, gp = _supervised_run(
+        mesh, mk_state, step, tmp_path, monkeypatch, "preempt@3",
+    )
+    assert restarts == 1 and int(state.step) == 8
+    merged = goodput.stitch(gp)
+    assert merged["segments"] == 2 and merged["restarts"] == 1
+    # preemption writes the emergency checkpoint at the EXACT step, so
+    # the supervisor counts zero redone steps — and so does the ledger
+    assert sup_redone == 0
+    assert merged["counts"]["steps_redone"] == sup_redone
+    assert merged["counts"]["steps"] == 8
+    # recovery is nonzero: restore inside incarnation 2 plus the
+    # supervisor's restart gap between the segments
+    assert merged["seconds"]["recovery"] > 0.0
+    summary = goodput.summarize_ledger(merged)
+    assert summary["residual_under_limit"], summary
+    # the checkpoint layer's save/wait joins fed their detail notes
+    assert summary["notes"].get("ckpt_save_block_s", 0.0) > 0.0
+    # the supervisor interleaved its restart row
+    kinds = [r["kind"] for r in goodput.read_rows(gp)]
+    assert kinds == ["segment", "restart", "segment"]
+
+
+@pytest.mark.timeout(120)
+def test_anomaly_restart_redone_matches_supervisor_exactly(
+    tiny_parts, tmp_path, monkeypatch
+):
+    """The other restart flavor: an anomaly abort resumes from an OLDER
+    checkpoint, so real work is re-done — the ledger's steps_redone
+    count must equal the supervisor's accounting exactly (here: abort at
+    step 6, newest verified generation 4, one completed step re-run)."""
+    mesh, mk_state, step = tiny_parts
+    state, fit, restarts, sup_redone, gp = _supervised_run(
+        mesh, mk_state, step, tmp_path, monkeypatch, "nan_loss@5,nan_loss@6",
+        anomaly_max=2,
+    )
+    assert restarts == 1 and int(state.step) == 8
+    assert sup_redone == 1  # done=5, newest verified ckpt=4
+    merged = goodput.stitch(gp)
+    assert merged["counts"]["steps_redone"] == sup_redone
+    assert merged["seconds"]["recovery"] > 0.0
+    # the redone seconds category is visible whenever a redone step is
+    # not the incarnation's compile payer; here step 5 IS the first
+    # re-executed step, so its seconds land in compile while the COUNT
+    # stays in steps_redone — the supervisor-match contract
+    summary = goodput.summarize_ledger(merged)
+    assert summary["residual_under_limit"], summary
+    assert summary["counts"]["steps_redone"] == 1
+
+
+@pytest.mark.timeout(120)
+def test_inprocess_rollback_segment_carries_anomaly_reason(
+    tiny_parts, tmp_path, monkeypatch
+):
+    """An anomaly handled by the Trainer's own rollback (no supervisor)
+    still stamps the aborted attempt's segment reason as AnomalyError —
+    a handled exception is invisible to sys.exc_info() in the finally,
+    so the except handler records it."""
+    from distributeddeeplearning_tpu.train.loop import Trainer, TrainerConfig
+
+    mesh, mk_state, step = tiny_parts
+    gp = str(tmp_path / "gp.jsonl")
+    monkeypatch.setenv(faults.ENV_VAR, "nan_loss@3,nan_loss@4")
+    faults.reset()
+    cfg = TrainerConfig(
+        epochs=2, steps_per_epoch=3, global_batch_size=GLOBAL_BATCH,
+        prefetch=0, anomaly_max_consecutive=2, anomaly_rollback=True,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every_steps=2,
+        goodput_path=gp,
+    )
+    state, fit = Trainer(mesh, step, config=cfg).fit(mk_state(), _factory)
+    assert fit.rollbacks == 1
+    segments = [r for r in goodput.read_rows(gp) if r["kind"] == "segment"]
+    assert [s["reason"] for s in segments] == ["AnomalyError", "completed"]
+    # both attempts belong to one run lineage (the rollback RESUMED)
+    assert {s["run"] for s in segments} == {0}
+
+
+# --------------------------------------------------------------------------
+# perf-trajectory tracker
+# --------------------------------------------------------------------------
+
+
+def _write_artifact(dirpath, name, payload):
+    path = Path(dirpath) / name
+    path.write_text(json.dumps(payload) + "\n")
+    return str(path)
+
+
+def _mini(decode_tps, rev):
+    return {
+        "metric": "mini_tok_sec",
+        "value": decode_tps,
+        "unit": "tok/sec",
+        "configs": {"f32": {"decode_tokens_per_sec": decode_tps}},
+        "bench_revision": rev,
+    }
+
+
+def test_history_timeline_and_green_gate(tmp_path):
+    _write_artifact(tmp_path, "MINI_r01.json", _mini(100.0, 1))
+    _write_artifact(tmp_path, "MINI_r02.json", _mini(99.0, 2))  # -1%: fine
+    rc, out = history.run_history(str(tmp_path), gate=True)
+    assert rc == 0
+    assert "MINI" in out and "GREEN" in out
+    timeline = history.build_timeline(history.load_points(str(tmp_path)))
+    series = timeline[("MINI", "configs.f32.decode_tokens_per_sec")]
+    assert [p.revision for p in series] == [1, 2]
+
+
+def test_history_gate_fails_on_injected_regression(tmp_path):
+    """ISSUE acceptance: the gate demonstrably fails (rc 1) on a fixture
+    artifact with an injected regression — decode tokens/sec down 10%
+    against the 5% tolerance."""
+    _write_artifact(tmp_path, "MINI_r01.json", _mini(100.0, 1))
+    _write_artifact(tmp_path, "MINI_r02.json", _mini(90.0, 2))
+    rc, out = history.run_history(str(tmp_path), gate=True)
+    assert rc == 1
+    assert "REGRESSION" in out and "decode_tokens_per_sec" in out
+    # without --gate the same regression is reported but not fatal
+    rc2, _ = history.run_history(str(tmp_path), gate=False)
+    assert rc2 == 0
+
+
+def test_history_lower_is_better_metrics_gate_on_rise(tmp_path):
+    for rev, pct in ((1, 4.0), (2, 20.0)):  # +16pp past the 5pp budget
+        _write_artifact(tmp_path, f"CHAOS_r{rev:02d}.json", {
+            "metric": "chaos_overhead", "value": pct, "unit": "%",
+            "recovery_overhead_pct": pct, "bench_revision": rev,
+        })
+    regressions = history.check_gates(
+        history.build_timeline(history.load_points(str(tmp_path)))
+    )
+    assert [r.path for r in regressions] == ["recovery_overhead_pct"]
+
+
+def test_history_skips_list_paths_as_positional(tmp_path):
+    """rows[5] at r01 and r02 can be DIFFERENT configs — list indices
+    are not identities, so list-nested metrics never become series."""
+    _write_artifact(tmp_path, "ROWS_r01.json", {
+        "metric": "m", "value": 1.0, "unit": "x",
+        "rows": [{"decode_tokens_per_sec": 100.0}],
+    })
+    _write_artifact(tmp_path, "ROWS_r02.json", {
+        "metric": "m", "value": 1.0, "unit": "x",
+        "rows": [{"decode_tokens_per_sec": 10.0}],  # would gate if tracked
+    })
+    timeline = history.build_timeline(history.load_points(str(tmp_path)))
+    assert not [
+        key for key in timeline if "decode_tokens_per_sec" in key[1]
+    ]
+    assert not history.check_gates(timeline)
+
+
+def test_history_rejects_schema_invalid_artifact(tmp_path):
+    # an artifact the schema sweep would reject fails the history GATE
+    # loudly instead of being silently skipped...
+    (tmp_path / "BAD_r01.json").write_text("not json at all")
+    _write_artifact(tmp_path, "MINI_r01.json", _mini(100.0, 1))
+    rc, out = history.run_history(str(tmp_path), gate=True)
+    assert rc == 1 and "schema" in out
+    # ...while INSPECTION mode (no --gate) warns and still renders the
+    # rest of the timeline (rc-1 semantics belong to the gate)
+    rc, out = history.run_history(str(tmp_path), gate=False)
+    assert rc == 0
+    assert "WARNING" in out and "MINI" in out
+
+
+def test_sparkline_shape():
+    assert history.sparkline([]) == ""
+    assert history.sparkline([1.0]) == "▄"
+    line = history.sparkline([0.0, 0.5, 1.0])
+    assert line[0] == "▁" and line[-1] == "█" and len(line) == 3
+
+
+def test_history_green_over_committed_artifacts():
+    """THE acceptance pin: ``ddlt obs history --gate`` runs green over
+    every committed artifact in the repo (tracked metrics may not have
+    regressed between adjacent committed revisions)."""
+    rc, out = history.run_history(REPO_ROOT, gate=True)
+    assert rc == 0, out
+
+
+def test_cli_obs_history_gate(monkeypatch, capsys):
+    from distributeddeeplearning_tpu.cli.main import main as cli_main
+
+    monkeypatch.chdir(REPO_ROOT)
+    rc = cli_main(["obs", "history", "--gate"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "GREEN" in out
+
+
+def test_cli_obs_history_json(monkeypatch, capsys):
+    from distributeddeeplearning_tpu.cli.main import main as cli_main
+
+    monkeypatch.chdir(REPO_ROOT)
+    rc = cli_main(["obs", "history", "--json"])
+    digest = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert digest["green"] is True
+    assert digest["tracked_series"] > 0
+
+
+# --------------------------------------------------------------------------
+# GOODPUT schema + sweep dispatch ordering
+# --------------------------------------------------------------------------
+
+
+def _goodput_payload(**overrides):
+    seconds = {c: 0.0 for c in CATEGORIES}
+    seconds.update(step_productive=6.0, compile=2.0, recovery=1.5,
+                   step_redone=0.5)
+    payload = {
+        "metric": "train_goodput_fraction", "value": 0.6, "unit": "fraction",
+        "bench_revision": 17, "platform": "cpu", "virtual_pod": False,
+        "faults_spec": "preempt@6",
+        "supervisor": {"restarts": 2, "redone_steps": 2},
+        "ledger": {
+            "total_wall_s": 10.0,
+            "seconds": seconds,
+            "goodput_fraction": 0.6,
+            "unaccounted_pct": 0.0,
+            "residual_limit_pct": 2.0,
+            "residual_under_limit": True,
+            "counts": {"steps": 17, "steps_redone": 2, "segments": 3},
+            "mfu": None,
+            "mfu_omitted_reason": "off-TPU",
+        },
+        "trajectory": {"green": True, "tracked_series": 4},
+        "gates": {
+            "residual_under_limit": True,
+            "redone_matches_supervisor": True,
+            "recovery_observed": True,
+            "completed_exact": True,
+            "trajectory_green": True,
+        },
+    }
+    payload.update(overrides)
+    return payload
+
+
+def test_goodput_schema_accepts_valid_payload():
+    validate_goodput_payload(_goodput_payload())
+
+
+def test_goodput_schema_rejects_categories_not_summing_to_wall():
+    """ISSUE satellite: a goodput payload whose categories don't sum to
+    wall is rejected — lost time must never read as high goodput."""
+    payload = _goodput_payload()
+    payload["ledger"]["seconds"]["step_productive"] = 1.0  # sum 5 of 10
+    with pytest.raises(SchemaError, match="residual gate"):
+        validate_goodput_payload(payload)
+
+
+def test_goodput_schema_rejects_silent_mfu_omission():
+    payload = _goodput_payload()
+    del payload["ledger"]["mfu_omitted_reason"]
+    with pytest.raises(SchemaError, match="mfu"):
+        validate_goodput_payload(payload)
+
+
+def test_goodput_sweep_dispatch_before_generic_fallback(tmp_path):
+    """ISSUE satellite: the artifact sweep matches GOODPUT_* to its
+    strict validator (ordered prefix table) — a goodput-named artifact
+    that only satisfies the generic bench-line checks must FAIL."""
+    path = _write_artifact(tmp_path, "GOODPUT_r99.json", {
+        "metric": "train_goodput_fraction", "value": 0.9, "unit": "fraction",
+    })
+    with pytest.raises(SchemaError, match="ledger"):
+        validate_artifact(path)
+    # a valid payload passes through the same dispatch
+    ok = _write_artifact(
+        tmp_path, "GOODPUT_r98.json", _goodput_payload()
+    )
+    validate_artifact(ok)
+
+
+def test_prefix_dispatch_order_is_most_specific_first():
+    from distributeddeeplearning_tpu.obs import schema
+
+    prefixes = [p for p, _ in schema._PREFIX_VALIDATORS]
+    # OBS_FLEET_ must dispatch before the OBS_ prefix it also matches
+    assert prefixes.index("OBS_FLEET_") < prefixes.index("OBS_")
+    # GOODPUT_ is dispatched (not left to the generic fallback)
+    assert "GOODPUT_" in prefixes
+
+
+def test_committed_goodput_artifact_passes_gates():
+    """The committed GOODPUT artifact is a real chaos run: schema-valid
+    (also covered by the tier-1 sweep), all gates true, recovery and
+    redone nonzero and supervisor-matched."""
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(REPO_ROOT, "GOODPUT_r*.json")))
+    assert paths, "no committed GOODPUT artifact"
+    data = validate_artifact(paths[-1])
+    assert all(data["gates"].values()), data["gates"]
+    assert data["ledger"]["seconds"]["recovery"] > 0.0
+    assert data["ledger"]["counts"]["steps_redone"] > 0
+    assert (
+        data["ledger"]["counts"]["steps_redone"]
+        == data["supervisor"]["redone_steps"]
+    )
+    assert data["trajectory"]["green"] is True
+
+
+# --------------------------------------------------------------------------
+# bench smoke (fast tier, child processes only)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_bench_goodput_smoke(tmp_path):
+    """bench.py --goodput --small end-to-end on CPU (slow tier — ~45s of
+    supervised chaos child processes): the stitched ledger, every gate
+    green, and the emitted artifact validating against its own schema.
+    The fast tier still pins the committed artifact + its gates."""
+    report = tmp_path / "GOODPUT_smoke.json"
+    proc = subprocess.run(
+        [
+            sys.executable, "bench.py", "--goodput", "--small",
+            "--report", str(report),
+        ],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=580,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    data = json.loads(report.read_text())
+    validate_goodput_payload(data)
+    assert all(data["gates"].values())
+    assert data["supervisor"]["restarts"] == 2
+    assert data["ledger"]["counts"]["steps_redone"] == (
+        data["supervisor"]["redone_steps"]
+    )
