@@ -12,8 +12,9 @@ PROJECT ?= smoke-test-project
 IMAGE ?= ddlt-control
 DATA_DIR ?= /data
 
-.PHONY: install test test-fast lint perf-history generate clean bench-smoke \
-        bench scaling dryrun docker-build docker-run docker-bash docker-stop
+.PHONY: install test test-fast lint perf-history obs-gate generate clean \
+        bench-smoke bench scaling dryrun docker-build docker-run \
+        docker-bash docker-stop
 
 install:
 	pip install -e .
@@ -21,8 +22,20 @@ install:
 test:
 	python -m pytest tests/ -x -q
 
-test-fast:
+# Tier-1 flow: the hermetic observability gate runs first (attribution
+# self-check + perf-trajectory gate, both seconds-cheap on CPU), then
+# the fast test tier.
+test-fast: obs-gate
 	python -m pytest tests/ -x -q -m "not slow"
+
+# Observability gate (obs/attrib.py + obs/history.py), hermetic: the
+# attribution self-check builds its own tiny engines on the CPU backend
+# and verifies program cost coverage + the HBM-ledger residual gates;
+# the history gate re-reads every committed artifact as one metric
+# timeline.  Non-zero exit on any gate failure.
+obs-gate:
+	python -m distributeddeeplearning_tpu.cli.main obs attrib --check
+	python -m distributeddeeplearning_tpu.cli.main obs history --gate
 
 # Static analysis (analysis/): AST hot-loop sync lint + jaxpr/HLO program
 # audits.  Non-zero exit on any unwaived finding (the CLI pins a virtual

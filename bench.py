@@ -42,7 +42,7 @@ V100_TF_CNN_BENCHMARKS_IMG_SEC = 720.0
 #: ``_rNN`` suffix (the drift that left COMMS at r09 while RESILIENCE sat
 #: at r07).  Committed artifacts keep their historical names; NEW runs
 #: write ``<KIND>_r{BENCH_REVISION}.json``.
-BENCH_REVISION = 17
+BENCH_REVISION = 18
 
 
 def artifact_name(kind: str) -> str:
@@ -2201,6 +2201,270 @@ def _run_goodput(args) -> int:
     return 0 if all(gates.values()) else 1
 
 
+def _run_attrib(args) -> int:
+    """Attribution benchmark (``obs/attrib.py`` + ``obs/ledger.py``):
+    run the serving engines (f32 dense, f32 paged, int8 paged), a
+    speculative decoder and a real ``Trainer`` fit in one process, then
+    emit the ``ATTRIB_r{NN}.json`` artifact — per-program
+    ``cost_analysis()`` flops/bytes + ``memory_analysis()`` residency,
+    the HBM ledger's owner totals reconciled against the process's
+    ACTUAL live device bytes, per-phase straggler timing from the run's
+    own tracer shards, the analytic compute-vs-collective split for the
+    train step, and a ledger-forecast admission demo.  Gates (rc 1):
+
+    - **programs_covered**: every tracked compiled program resolves a
+      cost row on this backend (CPU included — attribution is tier-1);
+    - **owner_totals_match_live**: ledger owner totals sum to the
+      process's live device bytes within 1%;
+    - **residual_under_limit**: unaccounted HBM ≤ 5% (bytes nobody owns
+      are how OOMs arrive undiagnosed);
+    - **forecast_backpressure**: with the ledger capacity sized for ~1
+      in-flight request, the scheduler serves every request to
+      completion by QUEUEING at predicted-headroom exhaustion — zero
+      errors, committed bytes never past capacity (no mid-decode OOM
+      path);
+    - **trajectory_green**: ``ddlt obs history`` gates green over every
+      committed artifact (the digest rides inside this one).
+    """
+    import itertools
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearning_tpu.data.synthetic import SyntheticDataset
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        init_params,
+    )
+    from distributeddeeplearning_tpu.obs import attrib as attrib_mod
+    from distributeddeeplearning_tpu.obs import history as history_mod
+    from distributeddeeplearning_tpu.obs.ledger import HBMLedger
+    from distributeddeeplearning_tpu.obs.schema import (
+        SchemaError,
+        validate_attrib_payload,
+    )
+    from distributeddeeplearning_tpu.obs.trace import configure
+    from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh
+    from distributeddeeplearning_tpu.parallel.sharding import shard_batch
+    from distributeddeeplearning_tpu.serve.engine import (
+        InferenceEngine,
+        PagedInferenceEngine,
+        _register_engine_owners,
+    )
+    from distributeddeeplearning_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+        synthetic_requests,
+    )
+    from distributeddeeplearning_tpu.spec.decode import SpeculativeDecoder
+    from distributeddeeplearning_tpu.train.loop import Trainer, TrainerConfig
+    from distributeddeeplearning_tpu.train.schedule import goyal_lr_schedule
+    from distributeddeeplearning_tpu.train.state import (
+        create_train_state,
+        sgd_momentum,
+    )
+    from distributeddeeplearning_tpu.train.step import build_train_step
+
+    small = args.small
+    # the run's own tracer feeds the straggler block (per-phase span
+    # durations); annotate=False keeps the device profiler out of it
+    tracer = configure(enabled=True, annotate=False)
+
+    # ---- serve phase: three engine configs + a speculative decoder ----
+    dims = dict(
+        num_layers=2, d_model=64 if not small else 32, num_heads=4,
+        d_ff=128 if not small else 64, vocab_size=509,
+    )
+    max_seq = 64
+    n_req = 8 if small else 16
+    new_tokens = 6 if small else 10
+    params = init_params(jax.random.key(0), max_len=max_seq, **dims)
+    nh = dims["num_heads"]
+    dense = InferenceEngine(
+        params, num_heads=nh, batch_slots=4, max_seq=max_seq,
+    )
+    paged = PagedInferenceEngine(
+        params, num_heads=nh, batch_slots=4, max_seq=max_seq,
+        page_size=16, prefill_chunk=16,
+    )
+    paged_int8 = PagedInferenceEngine(
+        params, num_heads=nh, batch_slots=4, max_seq=max_seq,
+        page_size=16, prefill_chunk=16, cache_dtype=jnp.int8,
+    )
+    reqs = synthetic_requests(
+        n_req, vocab_size=dims["vocab_size"], max_prompt=24,
+        shared_prefix_len=8, rng=np.random.default_rng(0),
+    )
+    print("[attrib] serving synthetic traffic on 3 engine configs",
+          file=sys.stderr)
+    for eng in (dense, paged, paged_int8):
+        ContinuousBatchingScheduler(
+            eng, max_new_tokens=new_tokens,
+        ).run(list(reqs))
+    decoder = SpeculativeDecoder(paged, drafter="truncated", draft_tokens=2)
+    ContinuousBatchingScheduler(
+        paged, max_new_tokens=new_tokens, spec_decoder=decoder,
+    ).run(list(reqs))
+    measured = {
+        "serve.dense.float32.decode": attrib_mod._time_decode(dense),
+        "serve.paged.float32.decode": attrib_mod._time_decode(paged),
+        "serve.paged.int8.decode": attrib_mod._time_decode(paged_int8),
+    }
+
+    # ---- train phase: a real Trainer fit (registers params/opt_state/
+    # batch_stats on the ledger and the train step in the cost registry)
+    steps, batch, img = (2, 4, (24, 24, 3)) if small else (3, 8, (32, 32, 3))
+    mesh = create_mesh(MeshSpec())
+    model = get_model("resnet18", num_classes=10, dtype=jnp.float32)
+    tx = sgd_momentum(goyal_lr_schedule(0.05, 1, steps_per_epoch=100))
+    state = create_train_state(jax.random.key(0), model, (batch, *img), tx)
+    step = build_train_step(mesh, state, compute_dtype=jnp.float32)
+    ds = SyntheticDataset(
+        length=batch * (steps + 2), image_shape=img, num_classes=10,
+    )
+    trainer = Trainer(
+        mesh, step,
+        config=TrainerConfig(
+            epochs=1, steps_per_epoch=steps, global_batch_size=batch,
+            log_every=10**9, prefetch=0,
+        ),
+    )
+    print(f"[attrib] {steps}-step trainer fit (resnet18)", file=sys.stderr)
+    state, _ = trainer.fit(
+        state, itertools.cycle(ds.batches(batch))
+    )
+    # steady-state step wall (post-compile): time direct step calls,
+    # then re-point the trainer's ledger provider at the LIVE state
+    # (the timed calls donated the fit's final state)
+    host_batch = next(iter(ds.batches(batch)))
+    dev_batch = shard_batch(mesh, host_batch)
+    walls = []
+    for _ in range(3):
+        t0 = _time.perf_counter()
+        state, _ = trainer.train_step(state, dev_batch)
+        jax.block_until_ready(state.params)
+        walls.append(_time.perf_counter() - t0)
+    trainer._obs_state = state
+    measured["train.step.implicit"] = min(walls)
+
+    # ---- forecast-backpressure demo: capacity for ~1 request ----------
+    demo_ledger = HBMLedger()
+    demo_engine = PagedInferenceEngine(
+        params, num_heads=nh, batch_slots=4, max_seq=max_seq,
+        page_size=16, prefill_chunk=16,
+    )
+    _register_engine_owners(demo_engine, demo_ledger)
+    demo_reqs = synthetic_requests(
+        6, vocab_size=dims["vocab_size"], max_prompt=24,
+        rng=np.random.default_rng(1),
+    )
+    worst = max(
+        demo_engine.admit_bytes(len(r.prompt), new_tokens)
+        for r in demo_reqs
+    )
+    capacity = demo_ledger.committed_bytes() + worst + demo_engine._page_bytes
+    demo_ledger.set_capacity(capacity)
+    _, demo_report = ContinuousBatchingScheduler(
+        demo_engine, max_new_tokens=new_tokens, hbm_ledger=demo_ledger,
+    ).run(list(demo_reqs))
+    forecast_ok = (
+        demo_report.errors == 0
+        and demo_report.requests == len(demo_reqs)
+        and demo_ledger.peak_committed_bytes <= capacity
+        and demo_ledger.peak_committed_bytes > 0
+    )
+    forecast_demo = {
+        "capacity_bytes": capacity,
+        "request_worst_case_bytes": worst,
+        "peak_committed_bytes": demo_ledger.peak_committed_bytes,
+        "requests": demo_report.requests,
+        "errors": demo_report.errors,
+        "finish_reasons": demo_report.finish_reasons,
+        "backpressure_held": forecast_ok,
+    }
+
+    # ---- the attribution frame ----------------------------------------
+    peak_tflops, peak_gbps, peaks_source = attrib_mod.reference_peaks()
+    report = attrib_mod.build_report(
+        memory=True, measured_step_s=measured,
+        peak_tflops=peak_tflops, peak_hbm_gbps=peak_gbps,
+    )
+    straggler = attrib_mod.straggler_report([tracer.to_chrome_trace()])
+    train_row = report["programs"].get("train.step.implicit") or {}
+    params_bytes = report["ledger"]["owners"].get("params", {}).get(
+        "bytes", 0
+    )
+    n_dev = jax.device_count()
+    split = attrib_mod.compute_collective_split(
+        float(train_row.get("flops") or 0.0),
+        # analytic ring-allreduce wire bytes for the implicit gradient
+        # sync: 2 · params · (n-1)/n per step
+        2.0 * params_bytes * (n_dev - 1) / max(n_dev, 1),
+        peak_flops=peak_tflops * 1e12,
+        interconnect_gbps=200.0,  # labeled reference figure, see below
+        measured_step_s=measured.get("train.step.implicit"),
+    )
+    split["interconnect_source"] = "reference-200GBps"
+    split["devices"] = n_dev
+
+    points = history_mod.load_points(".")
+    timeline = history_mod.build_timeline(points)
+    regressions = history_mod.check_gates(timeline)
+    trajectory = history_mod.timeline_digest(timeline, regressions)
+
+    gates = {
+        **report["gates"],
+        "forecast_backpressure": forecast_ok,
+        "trajectory_green": bool(trajectory["green"]),
+    }
+    line = {
+        "metric": "attrib_programs_covered",
+        "value": report["programs_covered"],
+        "unit": "programs",
+        "vs_baseline": None,
+        "bench_revision": BENCH_REVISION,
+        "platform": jax.default_backend(),
+        "virtual_pod": _is_virtual_pod(),
+        "programs": report["programs"],
+        "programs_covered": report["programs_covered"],
+        "owner_match_pct": report["owner_match_pct"],
+        "unaccounted_hbm_pct": report["unaccounted_hbm_pct"],
+        "peaks_source": peaks_source,
+        "measured_step_s": {
+            k: round(v, 6) for k, v in measured.items()
+        },
+        "ledger": report["ledger"],
+        "straggler": straggler,
+        "train_split_estimate": split,
+        "forecast_demo": forecast_demo,
+        "trajectory": trajectory,
+        "gates": gates,
+    }
+    try:
+        validate_attrib_payload(line)
+    except SchemaError as exc:
+        print(f"[attrib] artifact failed its own schema: {exc}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps({
+        k: line[k] for k in (
+            "metric", "value", "unit", "bench_revision", "platform",
+            "virtual_pod", "unaccounted_hbm_pct", "owner_match_pct",
+            "gates",
+        )
+    }))
+    report_path = args.report or artifact_name("ATTRIB")
+    with open(report_path, "w") as f:
+        json.dump(line, f, indent=2)
+        f.write("\n")
+    print(f"[attrib] report -> {report_path}", file=sys.stderr)
+    for name, ok in gates.items():
+        if not ok:
+            print(f"[attrib] GATE FAILED: {name}", file=sys.stderr)
+    return 0 if all(gates.values()) else 1
+
+
 def _run_serve_faults(args) -> int:
     """Serving chaos benchmark: the supervised replica fleet
     (``serve/fleet.py``) driven through an injected serve-side fault
@@ -3466,6 +3730,19 @@ def main() -> int:
         help="supervisor restart budget for --goodput",
     )
     parser.add_argument(
+        "--attrib",
+        action="store_true",
+        help="attribution benchmark (obs/attrib.py + obs/ledger.py): "
+        "per-program cost_analysis flops/bytes + memory_analysis "
+        "residency over the serve engines / spec decoder / train step, "
+        "HBM-ledger owner totals reconciled against live device bytes, "
+        "straggler phase timing, the analytic compute-vs-collective "
+        "split and a ledger-forecast admission demo; emits "
+        "ATTRIB_r{NN}.json gated on program coverage, the 1%% "
+        "owner-vs-live match, the <=5%% unaccounted-HBM residual and "
+        "forecast backpressure",
+    )
+    parser.add_argument(
         "--serve-faults",
         action="store_true",
         help="serving chaos benchmark: the supervised replica fleet "
@@ -3636,6 +3913,14 @@ def main() -> int:
         parser.error(
             "--goodput is exclusive with the other benchmark modes"
         )
+    if args.attrib and (args.serve or args.devices or args.data
+                        or args.faults or args.comms or args.quant
+                        or args.obs or args.obs_fleet or args.spec
+                        or args.serve_faults or args.ckpt_faults
+                        or args.goodput):
+        parser.error(
+            "--attrib is exclusive with the other benchmark modes"
+        )
     if args.serve_faults and (args.serve or args.devices or args.data
                               or args.faults or args.comms or args.quant
                               or args.obs):
@@ -3763,6 +4048,8 @@ def main() -> int:
         return _run_faults(args)
     if args.goodput:
         return _run_goodput(args)
+    if args.attrib:
+        return _run_attrib(args)
     if args.serve_faults:
         return _run_serve_faults(args)
     if args.ckpt_faults:

@@ -156,6 +156,7 @@ _OBS_TRACE = "distributeddeeplearning_tpu.obs.trace"
 _OBS_REG = "distributeddeeplearning_tpu.obs.registry"
 _OBS_RECORDER = "distributeddeeplearning_tpu.obs.recorder"
 _OBS_GOODPUT = "distributeddeeplearning_tpu.obs.goodput"
+_OBS_ATTRIB = "distributeddeeplearning_tpu.obs.attrib"
 OBS_HOT_REGIONS: Tuple[HotRegion, ...] = (
     HotRegion(name="obs-tracer-span", module=_OBS_TRACE, qualname="Tracer.span"),
     HotRegion(name="obs-tracer-event", module=_OBS_TRACE, qualname="Tracer.event"),
@@ -218,6 +219,19 @@ OBS_HOT_REGIONS: Tuple[HotRegion, ...] = (
         module=_OBS_GOODPUT,
         qualname="GoodputLedger.mark_step",
         landmarks=("self.mark(",),
+    ),
+    # the program-cost tracker's call path wraps EVERY jitted entry
+    # point (train step, decode, verify, ...): steady state is two jit
+    # cache-size reads around the forwarded call, and even the first-
+    # compile record touches only aval metadata — ZERO designed syncs
+    # (a buffer read here would serialize every step it wraps).  The
+    # landmark pins the forwarded dispatch: the wrapper must stay a
+    # pass-through, never grow its own device logic.
+    HotRegion(
+        name="obs-attrib-record",
+        module=_OBS_ATTRIB,
+        qualname="TrackedProgram.__call__",
+        landmarks=("fn(*args, **kwargs)",),
     ),
 )
 

@@ -544,6 +544,34 @@ def build_parser() -> argparse.ArgumentParser:
             help="output dir: device trace + merged.trace.json + "
             "obs-metrics.jsonl (default ./ddlt-obs)",
         )
+    obs_attrib = obs_sub.add_parser(
+        "attrib",
+        help="per-program cost/HBM attribution (obs/attrib.py): build "
+        "tiny dense+paged engines (and a speculative decoder) on the "
+        "current backend, serve synthetic traffic, then report every "
+        "compiled program's cost_analysis flops/bytes + memory_analysis "
+        "residency, the HBM ledger's owner totals reconciled against "
+        "the process's live device bytes, and achieved-vs-roofline per "
+        "program; --check exits nonzero when any attribution gate "
+        "fails (the make obs-gate half that needs jax)",
+    )
+    obs_attrib.add_argument(
+        "--check", action="store_true",
+        help="gate mode: print the gate verdicts only, exit 1 on any "
+        "failure (programs unresolvable, owner totals drifting from "
+        "live bytes, unaccounted-HBM residual past its limit)",
+    )
+    obs_attrib.add_argument(
+        "--json", action="store_true", help="print the full report JSON",
+    )
+    obs_attrib.add_argument(
+        "--report", default=None,
+        help="also write the full report JSON to this path",
+    )
+    obs_attrib.add_argument(
+        "--no-spec", action="store_true",
+        help="skip the speculative-decoder programs (faster smoke)",
+    )
     obs_history = obs_sub.add_parser(
         "history",
         help="perf-trajectory tracker (obs/history.py): parse every "
@@ -1816,6 +1844,8 @@ def _cmd_obs(args) -> int:
         )
         print(output)
         return rc
+    if args.obs_command == "attrib":
+        return _cmd_obs_attrib(args)
     if args.obs_command == "fleet":
         return _cmd_obs_fleet(args)
 
@@ -1945,6 +1975,81 @@ def _cmd_obs(args) -> int:
         f"[obs] open {merged_path} in chrome://tracing or "
         "https://ui.perfetto.dev", file=sys.stderr,
     )
+    return 0
+
+
+def _cmd_obs_attrib(args) -> int:
+    """``ddlt obs attrib [--check]`` — the attribution layer as a verb.
+
+    Hermetic by construction: the verb builds its own tiny engines and
+    traffic (no checkpoint, no network), so ``--check`` can run in CI
+    and ``make obs-gate`` on any box.  The CPU platform is pinned before
+    the first backend query, same recipe as ``ddlt lint`` — this must
+    never touch a hardware plugin over a dead tunnel."""
+    import json as _json
+    import os
+
+    if "JAX_PLATFORMS" not in os.environ:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    from distributeddeeplearning_tpu.utils.virtual_pod import (
+        force_cpu_platform_if_virtual_pod,
+    )
+
+    force_cpu_platform_if_virtual_pod()
+    from distributeddeeplearning_tpu.obs.attrib import self_check
+
+    ok, report = self_check(spec=not args.no_spec)
+    if args.report:
+        with open(args.report, "w") as f:
+            _json.dump(report, f, indent=2)
+            f.write("\n")
+    if args.json:
+        print(_json.dumps(report, indent=2))
+    elif args.check:
+        print(_json.dumps({
+            "gates": report["gates"],
+            "owner_match_pct": report["owner_match_pct"],
+            "unaccounted_hbm_pct": report["unaccounted_hbm_pct"],
+            "programs_covered": report["programs_covered"],
+        }))
+    else:
+        for name, row in sorted(report["programs"].items()):
+            flops = row["flops"] or 0.0
+            nbytes = row["bytes_accessed"] or 0.0
+            temp = row["temp_bytes"]
+            line = (
+                f"{name:<38} flops={flops:>12.0f} "
+                f"bytes={nbytes:>12.0f}"
+            )
+            if temp is not None:
+                line += f" temp={temp:>10d}"
+            rf = row.get("roofline")
+            if rf and rf.get("roofline_available"):
+                line += (
+                    f"  {rf['achieved_tflops']:.4f} TF/s "
+                    f"({rf['pct_of_compute_roofline']:.2%} of "
+                    f"{report['peaks_source']} compute peak, "
+                    f"bound={rf['bound']})"
+                )
+            print(line)
+        led = report["ledger"]
+        for owner, row in sorted(led["owners"].items()):
+            print(
+                f"hbm.{owner:<20} {row['bytes']:>12d} B "
+                f"(committed {row['committed_bytes']}, "
+                f"peak {row['peak_bytes']})"
+            )
+        print(
+            f"hbm total {led['total_bytes']} B of {led['live_bytes']} B "
+            f"live ({report['unaccounted_hbm_pct']}% unaccounted, "
+            f"limit {led['residual_limit_pct']}%)"
+        )
+        print(f"gates: {report['gates']}")
+    if not all(report["gates"].values()):
+        print("[obs attrib] GATE FAILED: " + ", ".join(
+            k for k, v in report["gates"].items() if not v
+        ), file=sys.stderr)
+        return 1
     return 0
 
 

@@ -28,13 +28,25 @@ The package every layer reports through (ISSUE 6 / OBS_r11):
 - :mod:`obs.history` — the perf-trajectory tracker: every committed
   ``*_r*.json`` read as one revision-keyed metric timeline, with a
   per-metric tolerance gate (``ddlt obs history --gate``);
+- :mod:`obs.attrib` — per-program cost attribution: every jitted entry
+  point's XLA cost-model flops/bytes recorded at first compile,
+  achieved-vs-roofline per program, per-host straggler timing and the
+  compute-vs-collective split estimate (``ddlt obs attrib``);
+- :mod:`obs.ledger` — the live HBM ledger: device bytes aggregated by
+  semantic owner (params / opt state / KV pages / quant scales /
+  drafter weights) with watermarks, the unaccounted-residual gate, and
+  the ``forecast()`` hook the serve scheduler consults before
+  admission;
 - :mod:`obs.schema` — artifact validation, so committed ``*_r*.json``
   drift fails tier-1 instead of rotting.
 
-Entry points: ``ddlt obs {train,serve,fleet,history}``, ``ddlt serve
---trace-dir``, ``make perf-history`` and ``bench.py --obs`` /
-``--obs-fleet`` / ``--goodput`` (the ``OBS_r{NN}.json`` /
-``OBS_FLEET_r{NN}.json`` / ``GOODPUT_r{NN}.json`` artifacts).
+Entry points: ``ddlt obs {train,serve,fleet,history,attrib}``,
+``ddlt serve --trace-dir``, ``make perf-history``, ``make obs-gate``
+and ``bench.py --obs`` / ``--obs-fleet`` / ``--goodput`` / ``--attrib``
+(the ``OBS_r{NN}.json`` / ``OBS_FLEET_r{NN}.json`` /
+``GOODPUT_r{NN}.json`` / ``ATTRIB_r{NN}.json`` artifacts).
+
+``docs/observability.md`` maps the whole stack with a worked example.
 """
 
 from distributeddeeplearning_tpu.obs.recorder import (

@@ -100,6 +100,12 @@ TOLERANCES: Dict[str, Tolerance] = {
     "mfu": Tolerance(higher_is_better=True, rel=0.05, abs=0.01),
     "goodput_fraction": Tolerance(higher_is_better=True, abs=0.05),
     "unaccounted_pct": Tolerance(higher_is_better=False, abs=1.0),
+    # HBM attribution (obs/attrib.py): bytes nobody owns may not creep
+    # past +1pp between revisions, and the set of compiled programs the
+    # cost registry resolves may never shrink (a program falling out of
+    # attribution is a lost instrumentation site, not noise)
+    "unaccounted_hbm_pct": Tolerance(higher_is_better=False, abs=1.0),
+    "programs_covered": Tolerance(higher_is_better=True, abs=0.0),
 }
 
 
@@ -154,12 +160,22 @@ def _extract(node: Any, path: str, out: List[Tuple[str, float]]) -> None:
 def load_points(
     root: str = ".", *, paths: Optional[List[str]] = None,
     validate: bool = True,
+    skipped: Optional[List[Tuple[str, str]]] = None,
 ) -> List[SeriesPoint]:
     """Parse every committed revision artifact into series points.
 
     Validation runs through :func:`obs.schema.validate_artifact` — the
     same sweep tier-1 runs — so the trajectory can never be built from
     an artifact the schema layer would reject.
+
+    MALFORMED artifacts — unreadable, truncated/partially-written JSON,
+    or an empty/non-container payload (a writer died mid-dump) — are a
+    different failure class from schema drift: they are SKIPPED with a
+    ``(file, reason)`` entry appended to ``skipped`` (when given)
+    instead of raising, in gate mode too.  A partially-written artifact
+    in the working tree must not brick the perf gate; only a genuine
+    tracked-metric regression (or committed schema drift, which the
+    tier-1 sweep also owns) may fail it.
     """
     from distributeddeeplearning_tpu.obs.schema import validate_artifact
 
@@ -174,6 +190,18 @@ def load_points(
         if not m:
             continue
         kind, rev = m.group("kind"), int(m.group("rev"))
+        # malformed pre-check (both modes): a file json can't even parse
+        # — or an empty container — is partially-written noise, not
+        # evidence; warn-and-skip, never raise
+        try:
+            with open(file) as f:
+                raw = json.load(f)
+            if not isinstance(raw, (dict, list)) or not raw:
+                raise json.JSONDecodeError("empty artifact", "", 0)
+        except (OSError, json.JSONDecodeError) as exc:
+            if skipped is not None:
+                skipped.append((file, f"{type(exc).__name__}: {exc}"))
+            continue
         if validate:
             data = validate_artifact(file)
         else:
@@ -352,17 +380,27 @@ def run_history(
     from distributeddeeplearning_tpu.obs.schema import SchemaError
 
     warning = ""
+    skipped: List[Tuple[str, str]] = []
     try:
-        points = load_points(root, paths=paths)
+        points = load_points(root, paths=paths, skipped=skipped)
     except SchemaError as exc:
         if gate:
             return 1, f"artifact failed schema validation: {exc}"
         # inspection mode: show what can be shown, loudly annotated —
         # the gate (and the tier-1 sweep) own the hard failure
         warning = f"WARNING: artifact failed schema validation: {exc}\n"
-        points = load_points(root, paths=paths, validate=False)
+        skipped = []
+        points = load_points(
+            root, paths=paths, validate=False, skipped=skipped,
+        )
+    for file, reason in skipped:
+        # malformed/partially-written artifacts: skipped with a warning
+        # in BOTH modes — rc stays regression-only (see load_points)
+        warning += (
+            f"WARNING: skipped malformed artifact {file} ({reason})\n"
+        )
     if not points:
-        return (1 if gate else 0), f"no *_r*.json artifacts under {root}"
+        return (1 if gate else 0), f"{warning}no *_r*.json artifacts under {root}"
     timeline = build_timeline(points)
     regressions = check_gates(timeline)
     if as_json:

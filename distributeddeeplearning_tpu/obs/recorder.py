@@ -49,11 +49,31 @@ __all__ = [
     "FlightRecorder",
     "get_recorder",
     "set_recorder",
+    "register_dump_context",
 ]
 
 #: how many dumps a recorder retains (a dump storm — e.g. a quarantine
 #: per step — must not grow without bound either)
 MAX_DUMPS = 8
+
+#: Dump-context providers: name -> zero-arg callable returning a JSON-
+#: ready block attached to every dump.  The attribution layer registers
+#: the latest HBM-ledger frame and the program-cost table here, so an
+#: OOM-adjacent crash dump arrives pre-diagnosed (who owned the bytes,
+#: what the programs cost) without the recorder importing either module
+#: — registration is the dependency direction, never an import from
+#: here.  Providers must be fast and host-only (they run mid-failure);
+#: a raising provider is skipped, never propagated.
+_DUMP_CONTEXT: Dict[str, Any] = {}
+
+
+def register_dump_context(name: str, provider) -> None:
+    """Attach ``provider()``'s block to every future dump under
+    ``name`` (last registration per name wins; ``None`` removes)."""
+    if provider is None:
+        _DUMP_CONTEXT.pop(name, None)
+    else:
+        _DUMP_CONTEXT[name] = provider
 
 
 class _RecorderSpan:
@@ -177,6 +197,16 @@ class FlightRecorder:
                 payload["metrics"] = registry.snapshot()
             except Exception:  # pragma: no cover - defensive
                 payload["metrics"] = None
+        # registered context blocks (HBM ledger frame, program-cost
+        # table, ...): best-effort, never overriding an explicit key —
+        # the dump runs mid-failure and must survive a broken provider
+        for name, provider in list(_DUMP_CONTEXT.items()):
+            if name in payload:
+                continue
+            try:
+                payload[name] = provider()
+            except Exception:
+                payload[name] = None
         self.dumps.append(payload)
         del self.dumps[:-MAX_DUMPS]
         if path is not None:

@@ -63,6 +63,7 @@ __all__ = [
     "validate_spec_payload",
     "validate_ckpt_durable_payload",
     "validate_goodput_payload",
+    "validate_attrib_payload",
 ]
 
 #: latency blocks whose percentile keys are a cross-artifact contract
@@ -687,6 +688,115 @@ def validate_goodput_payload(payload: Dict[str, Any]) -> None:
         raise SchemaError("; ".join(errors))
 
 
+def validate_attrib_payload(payload: Dict[str, Any]) -> None:
+    """Strict schema for the ``ATTRIB_r{NN}.json`` artifact body.
+
+    The attribution layer's evidence trail: every tracked compiled
+    program resolves XLA cost-model flops/bytes on the artifact's
+    backend, the HBM ledger's owner totals reconcile against the
+    process's ACTUAL live device bytes (the residual past the limit is
+    REJECTED here — unowned HBM reading as accounted-for is the failure
+    mode), and the gate verdicts travel with the numbers they judge.
+    """
+    errors: List[str] = []
+
+    def require(cond: bool, msg: str) -> None:
+        if not cond:
+            errors.append(msg)
+
+    for key in ("metric", "value", "unit", "bench_revision", "platform",
+                "virtual_pod", "programs", "programs_covered",
+                "unaccounted_hbm_pct", "ledger", "straggler", "gates"):
+        require(key in payload, f"missing top-level key {key!r}")
+
+    programs = payload.get("programs")
+    if isinstance(programs, dict) and programs:
+        for name, row in programs.items():
+            require(
+                isinstance(row, dict)
+                and isinstance(row.get("flops"), (int, float))
+                and isinstance(row.get("bytes_accessed"), (int, float)),
+                f"programs[{name!r}] must carry numeric flops + "
+                "bytes_accessed (the cost_analysis contract)",
+            )
+    else:
+        require(False, "programs must be a non-empty dict (one row per "
+                       "tracked compiled program)")
+
+    ledger = payload.get("ledger")
+    if isinstance(ledger, dict):
+        owners = ledger.get("owners")
+        require(
+            isinstance(owners, dict) and len(owners) >= 2,
+            "ledger.owners must hold at least two semantic owners "
+            "(params + a KV pool — one bucket is not attribution)",
+        )
+        if isinstance(owners, dict):
+            for owner, row in owners.items():
+                require(
+                    isinstance(row, dict)
+                    and isinstance(row.get("bytes"), int)
+                    and isinstance(row.get("committed_bytes"), int)
+                    and isinstance(row.get("peak_bytes"), int),
+                    f"ledger.owners[{owner!r}] must carry bytes/"
+                    "committed_bytes/peak_bytes ints",
+                )
+        live = ledger.get("live_bytes")
+        require(
+            isinstance(live, int) and live > 0,
+            "ledger.live_bytes must be a positive int (the reconcile "
+            "ran against a real process)",
+        )
+        limit = ledger.get("residual_limit_pct")
+        require(
+            isinstance(limit, (int, float)) and limit > 0,
+            "ledger.residual_limit_pct must be positive",
+        )
+        pct = payload.get("unaccounted_hbm_pct")
+        if isinstance(pct, (int, float)) and isinstance(
+            limit, (int, float)
+        ):
+            require(
+                pct <= float(limit) + 1e-9,
+                f"unaccounted_hbm_pct {pct} exceeds the {limit}% "
+                "residual gate — HBM nobody owns must fail the "
+                "artifact, not ride in it",
+            )
+        else:
+            require(False, "unaccounted_hbm_pct must be numeric")
+    else:
+        require(False, "ledger must be a dict")
+
+    straggler = payload.get("straggler")
+    if isinstance(straggler, dict):
+        require(
+            isinstance(straggler.get("phases"), dict),
+            "straggler.phases must be a dict (per-phase per-host rows)",
+        )
+        require(
+            straggler.get("negative_spans") == 0,
+            "straggler.negative_spans must be 0 (clock skew may never "
+            "manufacture negative durations)",
+        )
+    else:
+        require(False, "straggler must be a dict")
+
+    gates = payload.get("gates")
+    if isinstance(gates, dict):
+        for gk in ("programs_covered", "owner_totals_match_live",
+                   "residual_under_limit", "forecast_backpressure",
+                   "trajectory_green"):
+            require(
+                isinstance(gates.get(gk), bool),
+                f"gates.{gk} must be a bool",
+            )
+    else:
+        require(False, "gates must be a dict")
+
+    if errors:
+        raise SchemaError("; ".join(errors))
+
+
 #: Ordered most-specific-first: the FIRST matching prefix wins, so a
 #: name matching two prefixes (``OBS_FLEET_*`` also matches ``OBS_*``)
 #: binds to its specific schema, and every specific kind — ``GOODPUT_*``
@@ -699,6 +809,7 @@ _PREFIX_VALIDATORS = (
     ("SPEC_", validate_spec_payload),
     ("CKPT_DURABLE_", validate_ckpt_durable_payload),
     ("GOODPUT_", validate_goodput_payload),
+    ("ATTRIB_", validate_attrib_payload),
 )
 
 

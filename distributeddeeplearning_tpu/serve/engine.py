@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributeddeeplearning_tpu.obs.attrib import tracked_jit
+from distributeddeeplearning_tpu.obs.ledger import get_ledger
 from distributeddeeplearning_tpu.obs.trace import get_tracer
 from distributeddeeplearning_tpu.models.pipelined_transformer import (
     forward_decode,
@@ -61,6 +63,69 @@ from distributeddeeplearning_tpu.serve.kv_cache import (
 logger = logging.getLogger("ddlt.serve.engine")
 
 NEG_BIG = -1e30
+
+
+# -- HBM-ledger providers (module-level: the ledger holds the ENGINE via
+# weakref and calls these with it, so no closure can pin a dead engine's
+# cache alive through its own accounting) ----------------------------------
+
+def _ledger_params(engine):
+    return engine.params
+
+
+def _ledger_kv_values(engine):
+    return {
+        k: v for k, v in engine._cache.items() if not k.endswith("_scale")
+    }
+
+
+def _ledger_kv_scales(engine):
+    return {
+        k: v for k, v in engine._cache.items() if k.endswith("_scale")
+    }
+
+
+def _leaf_subset_page_bytes(cache, *, scales: bool) -> int:
+    """Per-page bytes of just the value (or just the scale) leaves —
+    the committed-bytes granule for the paged pool's ledger owners."""
+    return sum(
+        leaf.size // leaf.shape[0] * leaf.dtype.itemsize
+        for key, leaf in cache.items()
+        if key.endswith("_scale") == scales
+    )
+
+
+def _register_engine_owners(engine, ledger=None) -> None:
+    """Put the engine's device state on the HBM ledger (default: the
+    process ledger) by semantic owner: weights under ``params``, K/V
+    pools under ``kv_pages``, the int8 layout's f32 scales under
+    ``kv_scales`` — the decomposition the attribution artifact and the
+    crash dumps report.  Paged engines also report COMMITTED bytes
+    (pages actually in use × per-page bytes) so the admission forecast
+    prices demand, not the preallocated reservation."""
+    if ledger is None:
+        ledger = get_ledger()
+    ledger.register("params", engine, _ledger_params)
+    paged = getattr(engine, "kv_layout", "dense") == "paged"
+    if paged:
+        val_pb = _leaf_subset_page_bytes(engine._cache, scales=False)
+        ledger.register(
+            "kv_pages", engine, _ledger_kv_values,
+            committed=lambda e, pb=val_pb: e.allocator.pages_in_use * pb,
+        )
+    else:
+        ledger.register("kv_pages", engine, _ledger_kv_values)
+    if "k_scale" in engine._cache:
+        if paged:
+            sc_pb = _leaf_subset_page_bytes(engine._cache, scales=True)
+            ledger.register(
+                "kv_scales", engine, _ledger_kv_scales,
+                committed=lambda e, pb=sc_pb: (
+                    e.allocator.pages_in_use * pb
+                ),
+            )
+        else:
+            ledger.register("kv_scales", engine, _ledger_kv_scales)
 
 
 def sample_logits(
@@ -341,16 +406,26 @@ class InferenceEngine:
                 )
             return out
 
-        # one compiled prefill per prompt bucket (jit cache keyed on P)
-        self._prefill_jit = jax.jit(_prefill_fn)
-        self._insert_jit = jax.jit(
+        # one compiled prefill per prompt bucket (jit cache keyed on P);
+        # every program is tracked in the attribution registry (cost
+        # recorded at first compile — obs/attrib.py) under a name that
+        # carries layout + cache dtype, so f32 and int8 engines report
+        # distinguishable cost rows
+        tag = f"serve.dense.{self.kv_dtype}"
+        self._prefill_jit = tracked_jit(
+            f"{tag}.prefill", jax.jit(_prefill_fn)
+        )
+        self._insert_jit = tracked_jit(f"{tag}.insert", jax.jit(
             _insert_fn, donate_argnums=(0,), **insert_kw
-        )
-        self._decode_jit = jax.jit(
+        ))
+        self._decode_jit = tracked_jit(f"{tag}.decode", jax.jit(
             _decode_fn, donate_argnums=(1,), **jit_kw
-        )
+        ))
         self._sample_jit = jax.jit(_sample)
-        self._scrub_jit = jax.jit(_scrub_fn, donate_argnums=(0,))
+        self._scrub_jit = tracked_jit(f"{tag}.scrub", jax.jit(
+            _scrub_fn, donate_argnums=(0,)
+        ))
+        _register_engine_owners(self)
         logger.info(
             "engine: %d slots x seq %d, %d layers, cache %.1f MB (%s)%s",
             batch_slots, max_seq, num_layers,
@@ -377,6 +452,12 @@ class InferenceEngine:
         """Dense slots always fit a (validated) request — admission is
         gated by the scheduler's free-slot list alone."""
         return True
+
+    def admit_bytes(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Incremental committed HBM a request would add — zero for the
+        dense layout (every slot's reservation is committed up front),
+        so the scheduler's ledger forecast admits on headroom alone."""
+        return 0
 
     def release(self, slot: int) -> None:
         """No device state to reclaim: the slot's stale K/V stay masked
@@ -694,13 +775,21 @@ class PagedInferenceEngine:
             return out
 
         # one compiled chunk program per chunk shape (<= log2(chunk) of
-        # them: full chunks plus power-of-two final-chunk buckets)
-        self._chunk_jit = jax.jit(_chunk_fn, donate_argnums=(1,))
-        self._decode_jit = jax.jit(
+        # them: full chunks plus power-of-two final-chunk buckets); all
+        # tracked in the attribution registry (obs/attrib.py) per
+        # layout+dtype like the dense engine's programs
+        tag = f"serve.paged.{self.kv_dtype}"
+        self._chunk_jit = tracked_jit(f"{tag}.prefill_chunk", jax.jit(
+            _chunk_fn, donate_argnums=(1,)
+        ))
+        self._decode_jit = tracked_jit(f"{tag}.decode", jax.jit(
             _decode_fn, donate_argnums=(1,), static_argnums=(6,)
-        )
+        ))
         self._sample_jit = jax.jit(_sample)
-        self._scrub_jit = jax.jit(_scrub_fn, donate_argnums=(0,))
+        self._scrub_jit = tracked_jit(f"{tag}.scrub", jax.jit(
+            _scrub_fn, donate_argnums=(0,)
+        ))
+        _register_engine_owners(self)
         logger.info(
             "paged engine: %d slots, %d pages x %d tokens (+scratch), %d "
             "layers, pool %.1f MB (%s), chunk %d, prefix cache %s",
@@ -780,6 +869,17 @@ class PagedInferenceEngine:
         completions can never help; the scheduler fails it instead of
         deadlocking the queue."""
         return self.required_pages(prompt_len, max_new_tokens) <= self.num_pages
+
+    def admit_bytes(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Worst-case committed HBM this request would add (its full
+        page reservation × per-page bytes, scale leaves included) — the
+        demand the scheduler's ledger forecast prices before admission.
+        Conservative: a prefix-cache hit at ``prefill_begin`` commits
+        fewer fresh pages."""
+        return (
+            self.required_pages(prompt_len, max_new_tokens)
+            * self._page_bytes
+        )
 
     def _next_step(self) -> int:
         step = self._sample_step

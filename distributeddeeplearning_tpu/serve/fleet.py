@@ -73,6 +73,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from distributeddeeplearning_tpu.obs.fleet import fleet_latency
 from distributeddeeplearning_tpu.obs.goodput import post_warmup_tokens_per_sec
+from distributeddeeplearning_tpu.obs.ledger import get_ledger
 from distributeddeeplearning_tpu.obs.recorder import get_recorder
 from distributeddeeplearning_tpu.obs.registry import (
     get_registry,
@@ -209,6 +210,14 @@ class FleetReport:
     flight_recorder_dumps: List[Dict[str, Any]] = dataclasses.field(
         default_factory=list
     )
+    # per-replica HBM attribution (obs/ledger.py): each worker exports
+    # its ledger frame as hbm.* gauges with every metric ship, and the
+    # router lifts the LAST shipped frame per (replica, pid) incarnation
+    # here — which replica is closest to the memory cliff, by semantic
+    # owner, without a new wire channel
+    hbm_watermarks: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict
+    )
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -332,14 +341,38 @@ def _apply_reload(engine, spec: ReplicaSpec, ckpt_dir: str) -> Optional[int]:
     return step
 
 
+def _hbm_watermarks(metric_states) -> Dict[str, Dict[str, float]]:
+    """Per-replica ``hbm.*`` gauge frames lifted out of the shipped
+    registry states — the FleetReport's per-replica HBM watermark view
+    (``hbm.kv_pages.peak_bytes`` and friends, keyed ``replicaK-pid``)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for state in metric_states:
+        gauges = {
+            name: g.get("value")
+            for name, g in (state.get("gauges") or {}).items()
+            if name.startswith("hbm.")
+        }
+        if gauges:
+            key = (
+                f"replica{state.get('replica_id', '?')}"
+                f"-{state.get('pid', '?')}"
+            )
+            out[key] = gauges
+    return out
+
+
 def _ship_metrics(outbox, replica_id: int) -> None:
     """Ship this worker's full mergeable registry state to the router.
 
     Registered hot region (``fleet-worker-metrics-ship`` in
     ``analysis/regions.py``, sync budget 0): the state is host counters
     and histogram buckets by construction — a device value appearing on
-    this path means engine state leaked into the metrics plane.
-    """
+    this path means engine state leaked into the metrics plane.  The
+    HBM ledger's current frame rides every ship as ``hbm.*`` gauges
+    (host metadata math only — per-shard nbytes, never a buffer read),
+    so the router's per-replica watermarks stay fresh to the last ship
+    even across a replica death."""
+    get_ledger().export_gauges(get_registry())
     outbox.put(("metrics", replica_id, os.getpid(), get_registry().state()))
 
 
@@ -1507,6 +1540,7 @@ class FleetRouter:
             fleet_metrics=merged_registry.snapshot(),
             fleet_latency=fleet_latency(merged_registry),
             flight_recorder_dumps=router_dumps + self._worker_dumps,
+            hbm_watermarks=_hbm_watermarks(metric_states),
         )
         reg = get_registry()
         reg.counter("fleet.replica_deaths").inc(self.replica_deaths)

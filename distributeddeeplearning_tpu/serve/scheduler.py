@@ -307,6 +307,7 @@ class ContinuousBatchingScheduler:
         watchdog_on_timeout: Optional[Callable[[], None]] = None,
         result_window: Optional[int] = None,
         spec_decoder=None,
+        hbm_ledger="auto",
     ):
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
@@ -358,6 +359,15 @@ class ContinuousBatchingScheduler:
                 "scheduler drives — their caches would diverge silently"
             )
         self.spec_decoder = spec_decoder
+        # HBM-ledger admission forecast (obs/ledger.py): before admitting
+        # a request, the loop asks the ledger whether the request's
+        # worst-case committed bytes still fit the predicted headroom —
+        # backpressure by FORECAST, not by discovering the OOM mid-
+        # decode.  "auto" resolves to the process ledger at run() (so
+        # test swaps via set_ledger are honored); None disables.  With
+        # no capacity configured (the CPU mesh) the check is one
+        # attribute read.
+        self.hbm_ledger = hbm_ledger
         self._cancelled: set = set()
         # live weight reload (serve/fleet.py): a callable applied at the
         # next IDLE BARRIER — single attribute store/load, so setting it
@@ -451,6 +461,18 @@ class ContinuousBatchingScheduler:
         # truthiness check
         plan = faults_mod.get_plan()
         compiles_before = getattr(engine, "prefill_compiles", 0)
+        # admission HBM forecast: resolved once per run (honors test-time
+        # set_ledger swaps); duck-typed engines without admit_bytes opt
+        # out implicitly
+        if self.hbm_ledger == "auto":
+            from distributeddeeplearning_tpu.obs.ledger import get_ledger
+
+            hbm_ledger = get_ledger()
+        else:
+            hbm_ledger = self.hbm_ledger
+        admit_bytes = getattr(engine, "admit_bytes", None)
+        if admit_bytes is None:
+            hbm_ledger = None
         t_start = time.perf_counter()
 
         active: Dict[int, _SlotState] = {}
@@ -921,6 +943,7 @@ class ContinuousBatchingScheduler:
                 # Paged engines additionally gate on free PAGES: a request that
                 # could strand mid-decode is left queued (backpressure) until
                 # completions free its reservation.
+                hbm_committed = None  # ledger walk amortized per iteration
                 while (
                     pending and not draining and free
                     # reload pending: hold admission so the active set
@@ -965,6 +988,43 @@ class ContinuousBatchingScheduler:
                                 "flight (pages leaked?)"
                             ))
                             continue
+                    if hbm_ledger is not None:
+                        # predicted-headroom backpressure (obs/ledger.py):
+                        # free pages are necessary but not sufficient —
+                        # the ledger forecasts COMMITTED HBM across every
+                        # owner (params, other engines, quant scales),
+                        # so admission waits while in-flight work holds
+                        # the headroom instead of discovering the OOM
+                        # mid-decode
+                        extra = admit_bytes(len(req.prompt), budget)
+                        if extra:
+                            # the committed walk (a pytree traversal of
+                            # every registered provider) runs at most
+                            # once per scheduler iteration; admissions
+                            # within the iteration add their worst-case
+                            # reservation on top, so a burst can never
+                            # over-admit against one stale reading
+                            if (
+                                hbm_committed is None
+                                and hbm_ledger.capacity_bytes is not None
+                            ):
+                                hbm_committed = hbm_ledger.committed_bytes()
+                            if not hbm_ledger.admit_ok(
+                                extra, committed=hbm_committed
+                            ):
+                                if active or prefilling:
+                                    # completions release committed bytes
+                                    break
+                                pending.popleft()
+                                fail_request(req, RuntimeError(
+                                    f"predicted HBM headroom exhausted: the "
+                                    f"request would commit {extra} more bytes "
+                                    "past the ledger capacity with nothing in "
+                                    "flight to release any"
+                                ))
+                                continue
+                            if hbm_committed is not None:
+                                hbm_committed += extra
                     pending.popleft()
                     slot = free.pop()
                     # arrival-based: in live mode the loop may be hours

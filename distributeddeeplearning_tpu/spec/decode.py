@@ -44,8 +44,14 @@ from distributeddeeplearning_tpu.models.pipelined_transformer import (
     forward_verify,
     forward_verify_paged,
 )
+from distributeddeeplearning_tpu.obs.attrib import tracked_jit
+from distributeddeeplearning_tpu.obs.ledger import get_ledger
 from distributeddeeplearning_tpu.obs.trace import get_tracer
 from distributeddeeplearning_tpu.spec.drafter import Drafter, build_drafter
+
+
+def _ledger_drafter_params(drafter):
+    return getattr(drafter, "_dparams", None)
 
 
 @dataclasses.dataclass
@@ -213,8 +219,21 @@ class SpeculativeDecoder:
                     )
                 return out
 
-        self._verify_jit = jax.jit(_verify_fn, donate_argnums=(1,))
-        self._rollback_jit = jax.jit(_rollback_fn, donate_argnums=(0,))
+        # attribution: verify/rollback cost rows per layout
+        # (obs/attrib.py), and the drafter's own weight tree — sliced
+        # truncated blocks, int8 drafter params — on the HBM ledger
+        # under its semantic owner (leaves shared with the engine's
+        # params are deduplicated by the ledger walk)
+        tag = "spec.paged" if paged else "spec.dense"
+        self._verify_jit = tracked_jit(f"{tag}.verify", jax.jit(
+            _verify_fn, donate_argnums=(1,)
+        ))
+        self._rollback_jit = tracked_jit(f"{tag}.rollback", jax.jit(
+            _rollback_fn, donate_argnums=(0,)
+        ))
+        get_ledger().register(
+            "drafter_weights", self.drafter, _ledger_drafter_params
+        )
 
     # -- the draft -> verify hot loop ---------------------------------------
     def step(

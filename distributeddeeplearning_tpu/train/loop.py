@@ -371,6 +371,15 @@ class Trainer:
         )
 
         rollbacks = 0
+        # HBM attribution (obs/ledger.py): the train state's leaves go on
+        # the process ledger by semantic owner — params vs optimizer
+        # state vs batch stats — read through ``self._obs_state`` (the
+        # hot loop re-points it at the live state each step, so the
+        # providers always see the CURRENT buffers, never a donated
+        # generation).  Registered once per Trainer; the ledger holds the
+        # Trainer weakly, so dropping the Trainer drops the accounting.
+        self._obs_state = state
+        self._register_hbm_owners()
         # the ledger becomes the PROCESS ledger for the fit so deep
         # layers (Checkpointer save/wait joins) can attach their detail
         # notes without plumbing; restored in the outer finally
@@ -549,6 +558,28 @@ class Trainer:
         except Exception:  # MFU is an optional column, never a crash
             pass
 
+    def _register_hbm_owners(self) -> None:
+        """Register the train state's leaves on the process HBM ledger
+        (obs/ledger.py) by semantic owner.  Idempotent per Trainer; the
+        providers read ``self._obs_state``, which the hot loop re-points
+        at the live state every step."""
+        if getattr(self, "_hbm_registered", False):
+            return
+        self._hbm_registered = True
+        from distributeddeeplearning_tpu.obs.ledger import get_ledger
+
+        ledger = get_ledger()
+        def _of_state(attr):
+            def provider(trainer):
+                return getattr(
+                    getattr(trainer, "_obs_state", None), attr, None
+                )
+            return provider
+
+        ledger.register("params", self, _of_state("params"))
+        ledger.register("opt_state", self, _of_state("opt_state"))
+        ledger.register("batch_stats", self, _of_state("batch_stats"))
+
     def _emergency_stop(self, step: int, state, watchdog, guard=None) -> None:
         """Preemption noticed at a step boundary: synchronous emergency
         checkpoint, then PreemptionError (→ exit 75 under the runner)."""
@@ -659,6 +690,10 @@ class Trainer:
                         # MFU numerator (no-op off-TPU / ledger-disabled)
                         self._maybe_measure_flops(state, batch)
                     state, metrics = self.train_step(state, batch)
+                # re-point the HBM-ledger providers at the LIVE state
+                # (the previous generation's buffers were just donated);
+                # one attribute store — no sync, no walk
+                self._obs_state = state
                 anomalous = False
                 if detector is not None:
                     # One host sync per step — the price of reacting to a

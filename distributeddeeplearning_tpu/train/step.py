@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from distributeddeeplearning_tpu.obs.attrib import tracked_jit as _tracked_jit
 from distributeddeeplearning_tpu.parallel.sharding import (
     batch_sharding,
     param_shardings,
@@ -453,12 +454,15 @@ def build_train_step(
             metrics["lr"] = schedule(state.step).astype(jnp.float32)
         return new_state, metrics
 
-    return jax.jit(
+    # attribution (obs/attrib.py): the train step's cost_analysis flops/
+    # bytes are recorded at first compile and feed the MFU numerator,
+    # the roofline denominator and the ATTRIB artifact
+    return _tracked_jit("train.step.implicit", jax.jit(
         step_fn,
         in_shardings=(state_shardings, b_shard),
         out_shardings=(state_shardings, r_shard),
         donate_argnums=(0,),
-    )
+    ))
 
 
 class CommOverlapStep:
@@ -787,12 +791,12 @@ def _build_comm_overlap_step(
             metrics["lr"] = schedule(state.step).astype(jnp.float32)
         return new_state, metrics
 
-    jitted = jax.jit(
+    jitted = _tracked_jit("train.step.comm_overlap", jax.jit(
         step_fn,
         in_shardings=(state_shardings, b_shard),
         out_shardings=(state_shardings, r_shard),
         donate_argnums=(0,),
-    )
+    ))
     return CommOverlapStep(
         jitted, mesh, layout, comm_dtype=comm_dtype,
         weight_update_sharding=weight_update_sharding,
@@ -835,8 +839,8 @@ def build_eval_step(
         loss = loss_fn(logits, labels)
         return metrics_fn(logits, labels, loss)
 
-    return jax.jit(
+    return _tracked_jit("train.step.eval", jax.jit(
         step_fn,
         in_shardings=(state_shardings, b_shard),
         out_shardings=r_shard,
-    )
+    ))

@@ -32,6 +32,25 @@ _PEAK_BF16_FLOPS = [
 ]
 
 
+# device_kind substring (lowercased) -> peak HBM bandwidth GB/s per chip,
+# same datasheet sources (and the same substring keys) as the FLOPs table
+_PEAK_HBM_GBPS = [
+    ("v6e", 1640.0),  # Trillium
+    ("v6", 1640.0),
+    ("v5p", 2765.0),
+    ("v5e", 819.0),
+    ("v5 lite", 819.0),
+    ("v5litepod", 819.0),
+    ("v5", 2765.0),  # bare "TPU v5" = v5p
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+    ("h100", 3350.0),
+    ("a100", 1555.0),  # 40GB figure; the 80GB part reaches 2039
+    ("v100", 900.0),
+]
+
+
 def peak_bf16_flops(device: Optional[jax.Device] = None) -> Optional[float]:
     """Peak dense bf16 FLOP/s for ``device`` (default: first visible device).
 
@@ -49,6 +68,24 @@ def peak_bf16_flops(device: Optional[jax.Device] = None) -> Optional[float]:
     except Exception:
         return None  # no devices / kind-less backend: MFU omitted
     for key, peak in _PEAK_BF16_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def peak_hbm_gbps(device: Optional[jax.Device] = None) -> Optional[float]:
+    """Peak HBM bandwidth in GB/s for ``device`` (default: first visible
+    device).  Same contract as :func:`peak_bf16_flops`: None — never an
+    exception — for unrecognized or kind-less devices, so callers fall
+    back to labeled reference numbers instead of pairing a real compute
+    peak with another chip's memory ceiling."""
+    try:
+        if device is None:
+            device = jax.devices()[0]
+        kind = device.device_kind.lower()
+    except Exception:
+        return None
+    for key, peak in _PEAK_HBM_GBPS:
         if key in kind:
             return peak
     return None

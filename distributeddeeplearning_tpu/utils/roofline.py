@@ -35,6 +35,62 @@ _FLOPS_KEYS = ("model flops", "model_flops", "flops")
 _CATEGORY_KEYS = ("hlo_category", "category")
 
 
+def program_roofline(
+    flops: float,
+    bytes_accessed: float,
+    measured_s: float,
+    *,
+    peak_tflops: Optional[float] = None,
+    peak_hbm_gbps: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Achieved-vs-roofline verdict for ONE compiled program.
+
+    Feed the counted flops / bytes-accessed from the program cost
+    registry (``obs/attrib.py`` — XLA's own cost model) plus a measured
+    wall time: achieved FLOP/s and GB/s always report; with chip peaks
+    the pct-of-ceiling pair and the bound verdict follow — the roofline
+    time is ``max(flops/peak_flops, bytes/peak_bw)`` and ``efficiency``
+    is how much of the measured wall that ideal explains.  Without peaks
+    (the CPU test mesh — ``utils.hardware.peak_bf16_flops`` is None
+    there) the verdict reports ``roofline_available: False`` rather
+    than inventing a ceiling.
+    """
+    if measured_s <= 0:
+        raise ValueError(f"measured_s must be > 0, got {measured_s}")
+    out: Dict[str, Any] = {
+        "flops": float(flops),
+        "bytes_accessed": float(bytes_accessed),
+        "measured_s": round(measured_s, 6),
+        "achieved_tflops": round(flops / measured_s / 1e12, 4),
+        "achieved_gbps": round(bytes_accessed / measured_s / 1e9, 3),
+        "arithmetic_intensity": round(
+            flops / bytes_accessed, 3
+        ) if bytes_accessed else None,
+        "roofline_available": bool(peak_tflops and peak_hbm_gbps),
+    }
+    if not out["roofline_available"]:
+        return out
+    compute_s = flops / (peak_tflops * 1e12)
+    bandwidth_s = bytes_accessed / (peak_hbm_gbps * 1e9)
+    roofline_s = max(compute_s, bandwidth_s)
+    out.update({
+        "peak_tflops": peak_tflops,
+        "peak_hbm_gbps": peak_hbm_gbps,
+        "pct_of_compute_roofline": round(
+            flops / measured_s / (peak_tflops * 1e12), 4
+        ),
+        "pct_of_bandwidth_roofline": round(
+            bytes_accessed / measured_s / (peak_hbm_gbps * 1e9), 4
+        ),
+        "roofline_s": round(roofline_s, 6),
+        "efficiency": round(roofline_s / measured_s, 4),
+        "bound": (
+            "compute" if compute_s >= bandwidth_s else "hbm-bandwidth"
+        ),
+    })
+    return out
+
+
 def find_trace_file(trace_dir: str) -> str:
     """Newest ``*.trace.json.gz`` under ``trace_dir`` (xprof layout)."""
     pattern = os.path.join(trace_dir, "**", "*.trace.json.gz")

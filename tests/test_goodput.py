@@ -568,9 +568,11 @@ def test_history_skips_list_paths_as_positional(tmp_path):
 
 
 def test_history_rejects_schema_invalid_artifact(tmp_path):
-    # an artifact the schema sweep would reject fails the history GATE
-    # loudly instead of being silently skipped...
-    (tmp_path / "BAD_r01.json").write_text("not json at all")
+    # a PARSEABLE artifact the schema sweep would reject (schema drift)
+    # fails the history GATE loudly instead of being silently skipped —
+    # distinct from malformed/unparseable files, which are warn-and-skip
+    # in both modes (tests/test_attrib.py TestHistoryHardening)...
+    _write_artifact(tmp_path, "BAD_r01.json", {"metric": "x"})
     _write_artifact(tmp_path, "MINI_r01.json", _mini(100.0, 1))
     rc, out = history.run_history(str(tmp_path), gate=True)
     assert rc == 1 and "schema" in out
