@@ -42,7 +42,7 @@ V100_TF_CNN_BENCHMARKS_IMG_SEC = 720.0
 #: ``_rNN`` suffix (the drift that left COMMS at r09 while RESILIENCE sat
 #: at r07).  Committed artifacts keep their historical names; NEW runs
 #: write ``<KIND>_r{BENCH_REVISION}.json``.
-BENCH_REVISION = 18
+BENCH_REVISION = 19
 
 
 def artifact_name(kind: str) -> str:
@@ -2691,6 +2691,262 @@ def _run_serve_faults(args) -> int:
     return 0
 
 
+def _run_overload(args) -> int:
+    """Overload-survival chaos benchmark: a tenant-classed fleet driven
+    past capacity by a best-effort burst (``serve/traffic.py`` +
+    ``utils/faults.py`` ``burst``), measured against an ample-capacity
+    fault-free twin of the SAME schedule — the ``OVERLOAD_*.json``
+    artifact.  Gates (return code 1 on violation):
+
+    - **premium isolated**: premium TTFT/TPOT p99 stay within the
+      ``--overload-premium-*-limit`` bounds while best-effort visibly
+      degrades (its TTFT p99 is no better than premium's, or it paid
+      sheds/preemptions);
+    - **preempted streams bit-identical**: at least one request was
+      preempted mid-decode and resumed, and EVERY request that completed
+      ok carries exactly the clean run's greedy tokens — lossless
+      preemption is not allowed to change output;
+    - **zero lost requests**: every scheduled uid reaches a terminal
+      state and the router counts no losses (shed is terminal WITH a
+      retry hint, never silent loss);
+    - **shed only best-effort**: admission-time shedding happened (the
+      overload was real) and every shed landed in the best_effort class.
+
+    Both runs serve the byte-identical request set (deterministic
+    traffic seeds); the overload run feeds arrivals live through the
+    router's ``poll`` source while the clean twin takes them upfront
+    with ample slots/pages, so the delta IS the overload machinery.
+    """
+    import dataclasses as _dc
+
+    import jax
+
+    from distributeddeeplearning_tpu.serve import ReplicaSpec, serve_fleet
+    from distributeddeeplearning_tpu.serve.traffic import (
+        TenantSpec,
+        TrafficGenerator,
+        poll_source,
+    )
+    from distributeddeeplearning_tpu.utils import faults as faults_mod
+
+    dims = dict(num_layers=4, d_model=256, num_heads=8, d_ff=1024,
+                vocab_size=8193)
+    if args.small:
+        dims = dict(num_layers=2, d_model=64, num_heads=4, d_ff=128,
+                    vocab_size=257)
+    smoke = args.steps_cap is not None
+    duration_s = args.overload_duration_s
+    ttft_limit = args.overload_premium_ttft_limit
+    tpot_limit = args.overload_premium_tpot_limit
+    if smoke:
+        # CI smoke: shorter schedule, looser premium bounds (a throttled
+        # shared host doubles tails that have nothing to do with
+        # isolation); the structural gates stay exactly as strict
+        duration_s = min(duration_s, 4.0)
+        ttft_limit *= 2.0
+        tpot_limit *= 2.0
+    new_tokens = args.overload_new_tokens
+    max_prompt = 16
+    max_seq = max_prompt + new_tokens
+
+    tenants = (
+        TenantSpec(name="premium", priority="premium", rate_rps=1.5,
+                   arrival="poisson", prompt_min=2, prompt_max=max_prompt),
+        TenantSpec(name="standard", priority="standard", rate_rps=1.0,
+                   arrival="poisson", prompt_min=2, prompt_max=max_prompt),
+        TenantSpec(name="best_effort", priority="best_effort", rate_rps=1.0,
+                   arrival="poisson", prompt_min=2, prompt_max=max_prompt),
+    )
+    gen = TrafficGenerator(tenants, vocab_size=dims["vocab_size"], seed=0)
+    # the chaos spec CREATES the overload: schedule build consumes the
+    # burst fault and splices the extra best-effort arrivals in
+    plan = faults_mod.install_plan(args.overload_burst)
+    try:
+        schedule = gen.schedule(duration_s)
+        burst_fired = sum(1 for ev in plan.events if ev.kind == "burst")
+    finally:
+        faults_mod.reset()
+    if burst_fired == 0:
+        print(
+            f"[overload] burst spec {args.overload_burst!r} never fired "
+            "— no overload to survive (tenant name must match a "
+            "TenantSpec)", file=sys.stderr,
+        )
+        return 1
+    requests = [tr.request for tr in schedule]
+    by_tenant: dict = {}
+    for r in requests:
+        by_tenant[r.tenant] = by_tenant.get(r.tenant, 0) + 1
+
+    # scarce capacity BY DESIGN: 4 pages per sequence, 11 pages per
+    # replica — three slots but pages for ~2.5 concurrent sequences, so
+    # admission hits page pressure with a free slot (the preempt/shed
+    # ladder) and not just slot pressure
+    overload_spec = ReplicaSpec(
+        model=dict(max_len=max_seq, **dims),
+        seed=0,
+        num_heads=dims["num_heads"],
+        batch_slots=3,
+        max_seq=max_seq,
+        kv_layout="paged",
+        page_size=8,
+        num_pages=args.overload_kv_pages,
+        prefill_chunk=8,
+        temperature=0.0,  # greedy: the bit-identical gate needs it
+        max_new_tokens=new_tokens,
+        priority_classes=("premium", "standard", "best_effort"),
+        shed_policy="shed",
+        preempt_budget=args.overload_preempt_budget,
+    )
+    clean_spec = _dc.replace(
+        overload_spec, batch_slots=4, num_pages=None,
+        shed_policy="block",
+    )
+
+    print(
+        f"[overload] clean twin: 1 replica, ample capacity, "
+        f"{len(requests)} requests {by_tenant}", file=sys.stderr,
+    )
+    clean_res, clean_rep = serve_fleet(
+        clean_spec, requests, replicas=1, max_restarts=0,
+    )
+    if clean_rep.completed_ok != len(requests):
+        print(
+            f"[overload] clean twin degraded ({clean_rep.finish_reasons})"
+            " — no reference to diff the preempted streams against",
+            file=sys.stderr,
+        )
+        return 1
+    clean_tokens = {r.uid: list(r.tokens) for r in clean_res}
+
+    print(
+        f"[overload] overload fleet: {args.serve_replicas} replicas, "
+        f"{overload_spec.batch_slots} slots x {overload_spec.num_pages} "
+        f"pages, burst {args.overload_burst!r}, "
+        f"{duration_s}s schedule @ x{args.overload_speedup}",
+        file=sys.stderr,
+    )
+    results, rep = serve_fleet(
+        overload_spec, [],
+        replicas=args.serve_replicas,
+        max_restarts=1,
+        max_redeliveries=args.overload_max_redeliveries,
+        poll=poll_source(schedule, speedup=args.overload_speedup),
+    )
+
+    sub_uids = {r.uid for r in requests}
+    got_uids = {r.uid for r in results}
+    ok_reasons = ("eos", "length")
+    mismatched = [
+        r.uid for r in results
+        if r.finish_reason in ok_reasons
+        and list(r.tokens) != clean_tokens[r.uid]
+    ]
+    resumed = [
+        r.uid for r in results
+        if r.preemptions > 0 and r.finish_reason in ok_reasons
+    ]
+    per_class = rep.per_class
+    shed_by_class = {
+        cls: blk.get("shed", 0) for cls, blk in per_class.items()
+    }
+    shed_count = sum(shed_by_class.values())
+    preemptions = sum(
+        blk.get("preemptions", 0) for blk in per_class.values()
+    )
+    lat = rep.fleet_latency_per_class
+    inf = float("inf")
+
+    def p99(cls, block):
+        v = lat.get(cls, {}).get(block, {}).get("p99")
+        return float(v) if v is not None else inf
+
+    premium_ttft = p99("premium", "ttft_s")
+    premium_tpot = p99("premium", "tpot_s")
+    be_ttft = p99("best_effort", "ttft_s")
+    be_blk = per_class.get("best_effort", {})
+    be_suffered = (
+        be_ttft >= premium_ttft
+        or be_blk.get("shed", 0) > 0
+        or be_blk.get("preemptions", 0) > 0
+    )
+    gates = {
+        "premium_isolated": (
+            premium_ttft <= ttft_limit
+            and premium_tpot <= tpot_limit
+            and be_suffered
+        ),
+        "preempted_resume_bit_identical": (
+            len(resumed) > 0 and not mismatched
+        ),
+        "zero_lost_requests": (
+            rep.lost_requests == 0 and got_uids == sub_uids
+        ),
+        "shed_only_best_effort": (
+            shed_count > 0
+            and all(
+                n == 0 for cls, n in shed_by_class.items()
+                if cls != "best_effort"
+            )
+        ),
+    }
+    line = {
+        "metric": "overload_premium_ttft_p99_s",
+        "value": round(premium_ttft, 4),
+        "unit": "s",
+        "vs_baseline": None,
+        "bench_revision": BENCH_REVISION,
+        "faults_spec": args.overload_burst,
+        "replicas": args.serve_replicas,
+        "requests": len(requests),
+        "requests_by_tenant": by_tenant,
+        "duration_s": duration_s,
+        "speedup": args.overload_speedup,
+        "smoke": smoke,
+        "max_new_tokens": new_tokens,
+        "model_dims": dims,
+        "batch_slots": overload_spec.batch_slots,
+        "kv_pages": overload_spec.num_pages,
+        "preempt_budget": args.overload_preempt_budget,
+        "max_redeliveries": args.overload_max_redeliveries,
+        # the tracked tail latencies, FLAT at top level by contract
+        # (obs/history extracts leaves through dicts only)
+        "premium_ttft_p99_s": round(premium_ttft, 4),
+        "premium_tpot_p99_s": round(premium_tpot, 4),
+        "best_effort_ttft_p99_s": (
+            round(be_ttft, 4) if be_ttft != inf else None
+        ),
+        "premium_ttft_limit_s": ttft_limit,
+        "premium_tpot_limit_s": tpot_limit,
+        "shed_count": shed_count,
+        "shed_by_class": shed_by_class,
+        "preemptions": preemptions,
+        "per_class": per_class,
+        "resumed_streams": sorted(resumed),
+        "mismatched_uids": mismatched,
+        "gates": gates,
+        "clean": clean_rep.to_dict(),
+        "fleet_report": rep.to_dict(),
+        "platform": jax.default_backend(),
+        "virtual_pod": _is_virtual_pod(),
+    }
+    print(json.dumps({
+        k: line[k] for k in (
+            "metric", "value", "unit", "shed_count", "preemptions",
+            "gates",
+        )
+    }))
+    report_path = args.report or artifact_name("OVERLOAD")
+    with open(report_path, "w") as f:
+        json.dump(line, f, indent=2)
+        f.write("\n")
+    print(f"[overload] report -> {report_path}", file=sys.stderr)
+    if not all(gates.values()):
+        print(f"[overload] GATES FAILED: {gates}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _run_ckpt_faults(args) -> int:
     """Durable-state chaos benchmark (``train/checkpoint.py`` manifests +
     verified restore + live fleet weight reload) — the
@@ -3801,6 +4057,81 @@ def main() -> int:
         "never got its capacity back)",
     )
     parser.add_argument(
+        "--overload",
+        action="store_true",
+        help="overload-survival chaos benchmark: a tenant-classed fleet "
+        "(premium/standard/best_effort) under a best-effort arrival "
+        "burst with scarce KV pages, vs an ample-capacity fault-free "
+        "twin of the same deterministic schedule; emits "
+        "OVERLOAD_r{NN}.json and gates on premium tail isolation, "
+        "bit-identical preempted-then-resumed streams, zero lost "
+        "requests and best-effort-only shedding",
+    )
+    parser.add_argument(
+        "--overload-burst",
+        default="burst@1:tenant=best_effort:rps=40:secs=4:at=0.5",
+        help="DDLT_FAULTS burst spec consumed at traffic-schedule build "
+        "(utils/faults.py 'burst' kind) — the injected overload",
+    )
+    parser.add_argument(
+        "--overload-duration-s",
+        type=float,
+        default=8.0,
+        help="traffic schedule length in seconds for --overload",
+    )
+    parser.add_argument(
+        "--overload-speedup",
+        type=float,
+        default=1.0,
+        help="replay the --overload schedule compressed by this factor "
+        "(arrival order is preserved)",
+    )
+    parser.add_argument(
+        "--overload-new-tokens",
+        type=int,
+        default=16,
+        help="per-request generation budget for --overload (long enough "
+        "that a preempted stream has tokens worth preserving)",
+    )
+    parser.add_argument(
+        "--overload-kv-pages",
+        type=int,
+        default=11,
+        help="KV pages per replica for --overload (page_size 8, 4 pages "
+        "per sequence: 11 pages under 3 slots means admission hits PAGE "
+        "pressure with a slot free — the preempt-then-shed ladder, not "
+        "just slot queueing)",
+    )
+    parser.add_argument(
+        "--overload-preempt-budget",
+        type=int,
+        default=2,
+        help="per-request preemption budget for --overload (past it a "
+        "request finishes terminal 'preempted' instead of starving)",
+    )
+    parser.add_argument(
+        "--overload-max-redeliveries",
+        type=int,
+        default=1,
+        help="router redelivery budget for --overload (a shed result is "
+        "retried on another replica this many times before it finishes "
+        "terminal 'shed' with its retry_after_s hint)",
+    )
+    parser.add_argument(
+        "--overload-premium-ttft-limit",
+        type=float,
+        default=2.5,
+        help="premium-isolation gate for --overload: premium TTFT p99 "
+        "bound in seconds (doubled in --steps-cap smoke runs)",
+    )
+    parser.add_argument(
+        "--overload-premium-tpot-limit",
+        type=float,
+        default=0.5,
+        help="premium-isolation gate for --overload: premium TPOT p99 "
+        "bound in seconds (doubled in --steps-cap smoke runs)",
+    )
+    parser.add_argument(
         "--ckpt-faults",
         action="store_true",
         help="durable-state chaos benchmark: verified checkpoint "
@@ -3940,6 +4271,22 @@ def main() -> int:
         parser.error(
             "--ckpt-faults is exclusive with the other benchmark modes"
         )
+    if args.overload and (args.serve or args.devices or args.data
+                          or args.faults or args.comms or args.quant
+                          or args.obs or args.obs_fleet or args.spec
+                          or args.serve_faults or args.ckpt_faults
+                          or args.goodput or args.attrib):
+        parser.error(
+            "--overload is exclusive with the other benchmark modes"
+        )
+    if args.overload and args.serve_replicas < 2:
+        parser.error(
+            "--overload needs --serve-replicas >= 2 (premium isolation "
+            "across a fleet is the claim; one replica proves only local "
+            "queueing)"
+        )
+    if args.overload and args.overload_preempt_budget < 0:
+        parser.error("--overload-preempt-budget must be >= 0")
     if args.comms:
         if args.serve or args.devices or args.data or args.faults:
             parser.error(
@@ -4052,6 +4399,8 @@ def main() -> int:
         return _run_attrib(args)
     if args.serve_faults:
         return _run_serve_faults(args)
+    if args.overload:
+        return _run_overload(args)
     if args.ckpt_faults:
         return _run_ckpt_faults(args)
     if args.quant:

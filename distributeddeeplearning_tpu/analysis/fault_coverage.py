@@ -42,6 +42,8 @@ KIND_HOOKS: Dict[str, Tuple[str, ...]] = {
     "reject_admit": ("maybe_reject_admit",),
     "ckpt_corrupt": ("take_ckpt_corrupt",),
     "ckpt_torn": ("take_ckpt_torn",),
+    "burst": ("take_burst",),
+    "slow_tenant": ("take_slow_tenant",),
 }
 
 

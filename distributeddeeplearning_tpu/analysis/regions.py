@@ -111,6 +111,19 @@ HOT_REGIONS: Tuple[HotRegion, ...] = (
         landmarks=("restore_params(", "reload_params("),
         sync_budget=0,
     ),
+    HotRegion(
+        name="serve-preemption-decision",
+        module="distributeddeeplearning_tpu.serve.scheduler",
+        qualname="ContinuousBatchingScheduler._preemption_victim",
+        locator=None,  # the whole method IS the decision
+        # the preemption decision rides signals already on host — class
+        # ranks, per-slot generated-token counts, slot ids — so ANY sync
+        # token here means a device value leaked into victim selection
+        # (the overload path would then stall exactly when it must not).
+        # Landmarks pin the least-progress-within-lowest-class shape.
+        landmarks=("st.generated", "self._class_rank"),
+        sync_budget=0,
+    ),
 )
 
 #: Jitted step builders: no host-sync token at all — inside jit it would

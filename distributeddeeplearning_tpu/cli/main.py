@@ -453,6 +453,29 @@ def build_parser() -> argparse.ArgumentParser:
     serve_p.add_argument("--max-redeliveries", type=int, default=2,
                          help="failover retries per request before it "
                          "finishes 'error' (at-most-K redelivery)")
+    serve_p.add_argument("--priority-classes", default=None,
+                         help="comma-separated tenant priority classes, "
+                         "highest first (default 'premium,standard,"
+                         "best_effort'): higher classes dequeue first "
+                         "and may preempt lower-class decodes "
+                         "losslessly under slot/memory pressure")
+    serve_p.add_argument("--shed-policy", default="block",
+                         help="admission behavior under memory pressure: "
+                         "'block' (default) queues everything; 'shed' "
+                         "fails lowest-class requests fast with finish_"
+                         "reason 'shed' + a retry_after_s hint")
+    serve_p.add_argument("--preempt-budget", type=int, default=2,
+                         help="times one request may be preempted (and "
+                         "losslessly resumed) before it finishes "
+                         "terminal 'preempted' — bounds starvation")
+    serve_p.add_argument("--tenant-slo", action="append", default=None,
+                         metavar="CLASS:SPEC",
+                         help="per-class SLO, repeatable (--replicas > 1)"
+                         ": e.g. --tenant-slo premium:ttft_p99_s=2.0,"
+                         "max_error_rate=0 --tenant-slo best_effort:"
+                         "max_lost_requests=0; evaluated over the "
+                         "per-class bucket-merged fleet metrics, exit 1 "
+                         "on violation")
     serve_p.add_argument("--request-deadline-s", type=float, default=None,
                          help="per-request deadline: past it a request "
                          "finishes 'deadline' (queued: unstarted; "
@@ -537,6 +560,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="declarative SLO spec evaluated over the merged fleet "
         "metrics, e.g. 'ttft_p99_s=2.0,tpot_p99_s=0.5,"
         "max_error_rate=0,max_lost_requests=0'; exit 1 on violation",
+    )
+    obs_fleet.add_argument(
+        "--slo-per-tenant", action="append", default=None,
+        metavar="CLASS:SPEC",
+        help="per-priority-class SLO, repeatable: e.g. --slo-per-tenant "
+        "premium:ttft_p99_s=2.0,max_error_rate=0 --slo-per-tenant "
+        "best_effort:max_lost_requests=0; each class's spec is "
+        "evaluated over that class's bucket-merged fleet latency; "
+        "exit 1 on any violation",
     )
     for p in (obs_serve, obs_train, obs_fleet):
         p.add_argument(
@@ -1428,6 +1460,61 @@ def _cmd_serve(args) -> int:
         print("--requests must be >= 1", file=sys.stderr)
         return 1
 
+    # Multi-tenant knob guards, at parse time (the PR 8 rule: a bad knob
+    # fails HERE with one line, not as a traceback after a full engine
+    # build — or, worse on the fleet path, as N identical spawn errors).
+    priority_classes = ("premium", "standard", "best_effort")
+    if args.priority_classes is not None:
+        priority_classes = tuple(
+            c.strip() for c in args.priority_classes.split(",")
+        )
+        if not priority_classes or any(not c for c in priority_classes):
+            print(
+                "--priority-classes must be a non-empty comma-separated "
+                f"list (got {args.priority_classes!r})", file=sys.stderr,
+            )
+            return 1
+        if len(set(priority_classes)) != len(priority_classes):
+            print(
+                f"--priority-classes has duplicates: "
+                f"{args.priority_classes!r}", file=sys.stderr,
+            )
+            return 1
+    if args.shed_policy not in ("block", "shed"):
+        print(
+            f"--shed-policy must be 'block' or 'shed' "
+            f"(got {args.shed_policy!r})", file=sys.stderr,
+        )
+        return 1
+    if args.preempt_budget < 0:
+        print("--preempt-budget must be >= 0", file=sys.stderr)
+        return 1
+    class_slos = None
+    if args.tenant_slo:
+        if args.replicas <= 1:
+            print(
+                "--tenant-slo needs --replicas > 1: per-class SLOs are "
+                "evaluated over the bucket-merged FLEET metrics (single-"
+                "replica runs report per-class latency in the stats "
+                "JSON instead)", file=sys.stderr,
+            )
+            return 1
+        from distributeddeeplearning_tpu.obs.fleet import parse_class_slos
+
+        try:
+            class_slos = parse_class_slos(args.tenant_slo)
+        except ValueError as exc:
+            print(f"--tenant-slo: {exc}", file=sys.stderr)
+            return 1
+        unknown = sorted(set(class_slos) - set(priority_classes))
+        if unknown:
+            print(
+                f"--tenant-slo names unknown class(es) {unknown} — "
+                f"declared priority classes: {list(priority_classes)}",
+                file=sys.stderr,
+            )
+            return 1
+
     # Checkpoint FIRST: synthetic prompts and validation must see the
     # restored model's real vocab/position table, not the dim flags.
     params = None
@@ -1577,15 +1664,34 @@ def _cmd_serve(args) -> int:
             request_deadline_s=args.request_deadline_s,
             watchdog_deadline_s=args.watchdog_deadline_s,
             decode_kernel=args.decode_kernel,
+            priority_classes=priority_classes,
+            shed_policy=args.shed_policy,
+            preempt_budget=args.preempt_budget,
         )
         # validation (vocab / position-table clamp) is done with the
         # restored pytree; the workers restore their own copies, so
         # holding it through the fleet's whole life would be the exact
         # resident extra model the fleet path exists to avoid
         params = None
+        fleet_requests = [Request(uid=uid, prompt=p) for uid, p in prompts]
+        if class_slos and args.synthetic:
+            # synthetic smoke traffic is single-class ("standard") — an
+            # SLO'd class with zero samples FAILS by design, so deal the
+            # synthetic requests round-robin across the SLO'd classes
+            # (same convention as `ddlt obs fleet --slo-per-tenant`);
+            # real prompt traffic keeps whatever classes it arrived with
+            import dataclasses as _dc
+            slo_classes = sorted(class_slos)
+            fleet_requests = [
+                _dc.replace(
+                    r, tenant=slo_classes[i % len(slo_classes)],
+                    priority=slo_classes[i % len(slo_classes)],
+                )
+                for i, r in enumerate(fleet_requests)
+            ]
         results, freport = serve_fleet(
             spec,
-            [Request(uid=uid, prompt=p) for uid, p in prompts],
+            fleet_requests,
             replicas=args.replicas,
             max_restarts=args.max_restarts,
             max_redeliveries=args.max_redeliveries,
@@ -1595,6 +1701,25 @@ def _cmd_serve(args) -> int:
         stats = freport.to_dict()
         stats["platform"] = jax.default_backend()
         stats["virtual_pod"] = is_virtual_pod()
+        slo_violated = False
+        if class_slos:
+            from distributeddeeplearning_tpu.obs.fleet import (
+                evaluate_class_slos,
+            )
+
+            verdict = evaluate_class_slos(
+                class_slos,
+                fleet_report=stats,
+                per_class_latency=stats.get(
+                    "fleet_latency_per_class", {}
+                ),
+            )
+            stats["slo_per_tenant"] = verdict
+            for cls, res in sorted(verdict["per_class"].items()):
+                status = "PASS" if res["pass"] else "FAIL"
+                print(f"[serve] tenant SLO {cls}: {status}",
+                      file=sys.stderr)
+            slo_violated = not verdict["pass"]
         if args.synthetic:
             print(_json.dumps(stats))
         else:
@@ -1606,7 +1731,9 @@ def _cmd_serve(args) -> int:
                 _json.dump(stats, f, indent=2)
                 f.write("\n")
             print(f"[serve] report -> {args.report}", file=sys.stderr)
-        return RESUMABLE_EXIT_CODE if freport.drained else 0
+        if freport.drained:
+            return RESUMABLE_EXIT_CODE
+        return 1 if slo_violated else 0
 
     # Weight PTQ after validation (the checks above need the f32 head's
     # true vocab) and before engine build: with --calib-prompts the
@@ -1751,6 +1878,9 @@ def _cmd_serve(args) -> int:
         request_deadline_s=args.request_deadline_s,
         watchdog_deadline_s=args.watchdog_deadline_s,
         spec_decoder=spec_decoder,
+        priority_classes=priority_classes,
+        shed_policy=args.shed_policy,
+        preempt_budget=args.preempt_budget,
     )
     reqs = [Request(uid=uid, prompt=p) for uid, p in prompts]
     # SIGTERM -> graceful drain (stop admitting, finish active requests,
@@ -2068,11 +2198,16 @@ def _cmd_obs_fleet(args) -> int:
     --obs-fleet``; this verb is the quick "show me the fleet timeline"
     loop.
     """
+    import dataclasses as _dc
     import json as _json
 
     import numpy as np
 
-    from distributeddeeplearning_tpu.obs.fleet import SLOSpec, observe_fleet
+    from distributeddeeplearning_tpu.obs.fleet import (
+        SLOSpec,
+        observe_fleet,
+        parse_class_slos,
+    )
     from distributeddeeplearning_tpu.serve import (
         ReplicaSpec,
         synthetic_requests,
@@ -2083,6 +2218,22 @@ def _cmd_obs_fleet(args) -> int:
     except ValueError as exc:
         print(f"bad --slo: {exc}", file=sys.stderr)
         return 1
+    priority_classes = ("premium", "standard", "best_effort")
+    class_slos = None
+    if args.slo_per_tenant:
+        try:
+            class_slos = parse_class_slos(args.slo_per_tenant)
+        except ValueError as exc:
+            print(f"bad --slo-per-tenant: {exc}", file=sys.stderr)
+            return 1
+        unknown = sorted(set(class_slos) - set(priority_classes))
+        if unknown:
+            print(
+                f"--slo-per-tenant names unknown class(es) {unknown} — "
+                f"this smoke serves the classes {list(priority_classes)}",
+                file=sys.stderr,
+            )
+            return 1
     dims = dict(num_layers=2, d_model=64, num_heads=4, d_ff=128,
                 vocab_size=257)
     max_seq = args.prompt_len + args.max_new_tokens
@@ -2097,18 +2248,31 @@ def _cmd_obs_fleet(args) -> int:
         prefill_chunk=8,
         temperature=0.0,
         max_new_tokens=args.max_new_tokens,
+        priority_classes=priority_classes,
     )
     requests = synthetic_requests(
         args.requests, vocab_size=dims["vocab_size"],
         max_prompt=args.prompt_len,
         rng=np.random.default_rng(0),
     )
+    if class_slos:
+        # deal the synthetic traffic across the SLO'd classes round-
+        # robin: a class with an SLO but no traffic FAILS by design
+        # (an SLO that cannot be demonstrated is not met), which would
+        # make every run of this smoke verb exit 1
+        classes = sorted(class_slos)
+        requests = [
+            _dc.replace(r, tenant=classes[i % len(classes)],
+                        priority=classes[i % len(classes)])
+            for i, r in enumerate(requests)
+        ]
     view = observe_fleet(
         spec, requests,
         replicas=args.replicas,
         trace_dir=args.trace_dir,
         faults=args.faults,
         slo=slo,
+        class_slos=class_slos,
     )
     report = view["fleet_report"]
     chains_ok = sum(1 for c in view["failover"].values() if c["ok"])
@@ -2124,17 +2288,28 @@ def _cmd_obs_fleet(args) -> int:
         "failover_chains": len(view["failover"]),
         "failover_chains_ok": chains_ok,
         "fleet_latency": view["fleet_latency"],
+        "fleet_latency_per_class": view["fleet_latency_per_class"],
         "flight_recorder_dumps": len(view["flight_recorder_dumps"]),
         "slo": view["slo"],
+        "slo_per_tenant": view["slo_per_tenant"],
     }))
     print(
         f"[obs] open {view['merged_trace_path']} in chrome://tracing or "
         "https://ui.perfetto.dev", file=sys.stderr,
     )
+    rc = 0
     if view["slo"] is not None and not view["slo"]["pass"]:
         print("[obs] SLO VIOLATED", file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    per_tenant = view["slo_per_tenant"]
+    if per_tenant is not None and not per_tenant["pass"]:
+        failed = sorted(
+            cls for cls, res in per_tenant["per_class"].items()
+            if not res["pass"]
+        )
+        print(f"[obs] per-tenant SLO VIOLATED: {failed}", file=sys.stderr)
+        rc = 1
+    return rc
 
 
 def _cmd_tpu(args) -> int:

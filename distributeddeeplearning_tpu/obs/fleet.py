@@ -50,11 +50,17 @@ __all__ = [
     "failover_chains",
     "check_failover_chain",
     "fleet_latency",
+    "fleet_latency_per_class",
+    "parse_class_slos",
+    "evaluate_class_slos",
     "observe_fleet",
 ]
 
 #: fleet histogram names the SLO layer reads — the scheduler's end-of-run
-#: rollup feeds these in every worker (obs/registry names are a contract)
+#: rollup feeds these in every worker (obs/registry names are a contract);
+#: per-priority-class splits ride the same names with a ``.<class>``
+#: suffix (``serve.ttft_s.premium`` ...), fed per completion by the
+#: scheduler's finish path
 TTFT_HISTOGRAM = "serve.ttft_s"
 TPOT_HISTOGRAM = "serve.tpot_s"
 
@@ -265,6 +271,34 @@ def fleet_latency(merged_registry: MetricsRegistry) -> Dict[str, Any]:
     }
 
 
+def fleet_latency_per_class(
+    merged_registry: MetricsRegistry,
+) -> Dict[str, Dict[str, Any]]:
+    """Per-priority-class TTFT/TPOT blocks from the bucket-merged
+    ``serve.ttft_s.<class>`` / ``serve.tpot_s.<class>`` histograms —
+    the same never-average-percentiles rule as :func:`fleet_latency`,
+    split by SLO class.  Classes are discovered from the metric names
+    (a class no worker ever served simply isn't here)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    ttft_prefix = TTFT_HISTOGRAM + "."
+    tpot_prefix = TPOT_HISTOGRAM + "."
+    for name, hist in merged_registry._histograms.items():
+        if name.startswith(ttft_prefix):
+            blk = out.setdefault(name[len(ttft_prefix):], {})
+            blk["ttft_s"] = hist.summary()
+            blk["ttft_samples"] = hist.count
+        elif name.startswith(tpot_prefix):
+            blk = out.setdefault(name[len(tpot_prefix):], {})
+            blk["tpot_s"] = hist.summary()
+            blk["tpot_samples"] = hist.count
+    for blk in out.values():
+        blk.setdefault("ttft_s", {})
+        blk.setdefault("ttft_samples", 0)
+        blk.setdefault("tpot_s", {})
+        blk.setdefault("tpot_samples", 0)
+    return out
+
+
 @dataclasses.dataclass
 class SLOSpec:
     """Declarative service-level objectives over the merged fleet view.
@@ -362,6 +396,58 @@ class SLOSpec:
         }
 
 
+def parse_class_slos(entries: Sequence[str]) -> Dict[str, "SLOSpec"]:
+    """Parse repeated ``<class>:<key=value,...>`` flags (``ddlt obs
+    fleet --slo-per-tenant``) into a class -> :class:`SLOSpec` map.
+    Raises on a missing class prefix or a duplicate class — the CLI
+    surfaces these at parse time, before any engine builds."""
+    out: Dict[str, SLOSpec] = {}
+    for entry in entries or []:
+        cls, sep, spec_text = entry.partition(":")
+        cls = cls.strip()
+        if not sep or not cls or any(c.isspace() for c in cls):
+            raise ValueError(
+                f"per-tenant SLO {entry!r} is not <class>:<key=value,...>"
+            )
+        if cls in out:
+            raise ValueError(f"duplicate per-tenant SLO for class {cls!r}")
+        out[cls] = SLOSpec.parse(spec_text)
+    return out
+
+
+def evaluate_class_slos(
+    class_slos: Dict[str, "SLOSpec"],
+    *,
+    fleet_report: Dict[str, Any],
+    per_class_latency: Dict[str, Any],
+) -> Dict[str, Any]:
+    """Evaluate each class's spec against THAT class's bucket-merged
+    latency and its slice of the fleet report's ``per_class`` block.
+    ``lost_requests`` is fleet-global and charged to every evaluated
+    class — a lost request is an SLO violation no matter whose it was.
+    A class with an SLO but zero recorded samples FAILS its latency
+    criteria (an SLO that cannot be demonstrated is not met)."""
+    per: Dict[str, Any] = {}
+    report_classes = fleet_report.get("per_class", {}) or {}
+    empty = {
+        "ttft_s": {}, "tpot_s": {}, "ttft_samples": 0, "tpot_samples": 0,
+    }
+    for cls, spec in sorted(class_slos.items()):
+        blk = report_classes.get(cls, {})
+        per[cls] = spec.evaluate(
+            fleet_report={
+                "requests": blk.get("requests", 0),
+                "errors": blk.get("errors", 0),
+                "lost_requests": fleet_report.get("lost_requests", 0),
+            },
+            latency=per_class_latency.get(cls, empty),
+        )
+    return {
+        "per_class": per,
+        "pass": all(r["pass"] for r in per.values()),
+    }
+
+
 # -- the shared choreography ----------------------------------------------
 
 
@@ -373,6 +459,7 @@ def observe_fleet(
     trace_dir: str,
     faults: Optional[str] = None,
     slo: Optional[SLOSpec] = None,
+    class_slos: Optional[Dict[str, SLOSpec]] = None,
     max_restarts: int = 1,
     max_redeliveries: int = 2,
     heartbeat_timeout_s: Optional[float] = None,
@@ -458,6 +545,18 @@ def observe_fleet(
         if slo is not None
         else None
     )
+    # per-tenant SLOs (PR 17): each class's spec against that class's
+    # bucket-merged latency split — same single-computation rule, the
+    # router's fleet_latency_per_class is the one source
+    slo_per_tenant = (
+        evaluate_class_slos(
+            class_slos,
+            fleet_report=report.to_dict(),
+            per_class_latency=report.fleet_latency_per_class,
+        )
+        if class_slos
+        else None
+    )
     return {
         "results": results,
         "fleet_report": report,
@@ -466,8 +565,10 @@ def observe_fleet(
         "timeline": summarize_timeline(merged),
         "failover": failover,
         "fleet_latency": latency,
+        "fleet_latency_per_class": report.fleet_latency_per_class,
         "fleet_metrics": report.fleet_metrics,
         "per_replica_metrics": list(report.replica_metric_states),
         "slo": slo_result,
+        "slo_per_tenant": slo_per_tenant,
         "flight_recorder_dumps": report.flight_recorder_dumps,
     }

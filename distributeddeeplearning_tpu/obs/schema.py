@@ -64,6 +64,7 @@ __all__ = [
     "validate_ckpt_durable_payload",
     "validate_goodput_payload",
     "validate_attrib_payload",
+    "validate_overload_payload",
 ]
 
 #: latency blocks whose percentile keys are a cross-artifact contract
@@ -797,6 +798,104 @@ def validate_attrib_payload(payload: Dict[str, Any]) -> None:
         raise SchemaError("; ".join(errors))
 
 
+def validate_overload_payload(payload: Dict[str, Any]) -> None:
+    """Strict schema for the ``OVERLOAD_r{NN}.json`` artifact body.
+
+    The overload-survival evidence trail: a fleet driven past capacity by
+    a best-effort burst while premium traffic rides through.  The four
+    gate booleans are the contract — premium tail isolated, preempted
+    streams bit-identical after resume, zero lost requests, shedding
+    confined to the best-effort class — and the tracked tail latencies
+    live as FLAT top-level leaves (``premium_ttft_p99_s`` etc.) because
+    the history tracker extracts by leaf key through dicts only.
+    """
+    errors: List[str] = []
+
+    def require(cond: bool, msg: str) -> None:
+        if not cond:
+            errors.append(msg)
+
+    for key in ("metric", "value", "unit", "bench_revision", "platform",
+                "virtual_pod", "faults_spec", "replicas",
+                "premium_ttft_p99_s", "premium_tpot_p99_s",
+                "best_effort_ttft_p99_s", "shed_count", "preemptions",
+                "shed_by_class", "per_class", "gates", "fleet_report"):
+        require(key in payload, f"missing top-level key {key!r}")
+
+    # best_effort_ttft_p99_s may be null (a burst shed to extinction has
+    # no completed best-effort sample) — the PREMIUM leaves never may,
+    # they are the tracked isolation headline
+    for key in ("premium_ttft_p99_s", "premium_tpot_p99_s"):
+        require(
+            isinstance(payload.get(key), (int, float)),
+            f"{key} must be numeric (the tracked tail latencies are "
+            "flat top-level leaves by contract)",
+        )
+    for key in ("shed_count", "preemptions"):
+        require(
+            isinstance(payload.get(key), int)
+            and payload.get(key, -1) >= 0,
+            f"{key} must be a non-negative int",
+        )
+
+    shed_by_class = payload.get("shed_by_class")
+    if isinstance(shed_by_class, dict) and shed_by_class:
+        non_be = {
+            cls: n for cls, n in shed_by_class.items()
+            if cls != "best_effort" and isinstance(n, int) and n > 0
+        }
+        require(
+            not non_be,
+            "shed_by_class shows sheds OUTSIDE best_effort "
+            f"({sorted(non_be)}) — shedding must stay in the lowest "
+            "class",
+        )
+    else:
+        require(False, "shed_by_class must be a non-empty dict "
+                       "(class -> shed count, zeros included)")
+
+    per_class = payload.get("per_class")
+    if isinstance(per_class, dict):
+        for cls in ("premium", "best_effort"):
+            blk = per_class.get(cls)
+            require(
+                isinstance(blk, dict)
+                and isinstance(blk.get("requests"), int),
+                f"per_class[{cls!r}] must carry a request count (an "
+                "overload run without both classes proves nothing "
+                "about isolation)",
+            )
+    else:
+        require(False, "per_class must be a dict")
+
+    gates = payload.get("gates")
+    if isinstance(gates, dict):
+        for gk in ("premium_isolated", "preempted_resume_bit_identical",
+                   "zero_lost_requests", "shed_only_best_effort"):
+            require(
+                isinstance(gates.get(gk), bool),
+                f"gates.{gk} must be a bool",
+            )
+    else:
+        require(False, "gates must be a dict")
+
+    rep = payload.get("fleet_report")
+    if isinstance(rep, dict):
+        for key in ("replicas", "requests", "lost_requests",
+                    "finish_reasons", "per_class",
+                    "fleet_latency_per_class"):
+            require(key in rep, f"fleet_report missing key {key!r}")
+        require(
+            isinstance(rep.get("lost_requests"), int),
+            "fleet_report.lost_requests must be an int",
+        )
+    else:
+        require(False, "fleet_report must be a dict")
+
+    if errors:
+        raise SchemaError("; ".join(errors))
+
+
 #: Ordered most-specific-first: the FIRST matching prefix wins, so a
 #: name matching two prefixes (``OBS_FLEET_*`` also matches ``OBS_*``)
 #: binds to its specific schema, and every specific kind — ``GOODPUT_*``
@@ -810,6 +909,7 @@ _PREFIX_VALIDATORS = (
     ("CKPT_DURABLE_", validate_ckpt_durable_payload),
     ("GOODPUT_", validate_goodput_payload),
     ("ATTRIB_", validate_attrib_payload),
+    ("OVERLOAD_", validate_overload_payload),
 )
 
 

@@ -69,9 +69,12 @@ import os
 import queue as queue_mod
 import signal
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from distributeddeeplearning_tpu.obs.fleet import fleet_latency
+from distributeddeeplearning_tpu.obs.fleet import (
+    fleet_latency,
+    fleet_latency_per_class,
+)
 from distributeddeeplearning_tpu.obs.goodput import post_warmup_tokens_per_sec
 from distributeddeeplearning_tpu.obs.ledger import get_ledger
 from distributeddeeplearning_tpu.obs.recorder import get_recorder
@@ -132,6 +135,16 @@ class ReplicaSpec:
     max_new_tokens: int = 32
     request_deadline_s: Optional[float] = None
     watchdog_deadline_s: Optional[float] = None
+    # multi-tenant overload protection (PR 17), passed straight to each
+    # worker's ContinuousBatchingScheduler: priority classes highest
+    # first, the admission shed policy, and the per-request lossless-
+    # preemption budget.  Tuple (not list) keeps the spec hashable-ish
+    # and the default immutable across pickling.
+    priority_classes: Tuple[str, ...] = (
+        "premium", "standard", "best_effort",
+    )
+    shed_policy: str = "block"
+    preempt_budget: int = 2
     # distributed tracing: when set, every worker enables its own tracer
     # (pid/process_name derived from the worker, replica context stamped
     # on every span) and exports a Chrome-trace SHARD here —
@@ -147,6 +160,26 @@ class ReplicaSpec:
         if not self.checkpoint_dir and not self.model:
             raise ValueError(
                 "ReplicaSpec needs either model dims or a checkpoint_dir"
+            )
+        # mirror the scheduler's own validation HERE, before any worker
+        # spawns: a bad knob should fail in the router process, not as N
+        # spawn_errors after N jax imports
+        classes = tuple(self.priority_classes)
+        if not classes or any(
+            not isinstance(c, str) or not c for c in classes
+        ) or len(set(classes)) != len(classes):
+            raise ValueError(
+                "priority_classes must be unique non-empty class names, "
+                f"got {self.priority_classes!r}"
+            )
+        if self.shed_policy not in ("block", "shed"):
+            raise ValueError(
+                f"shed_policy must be 'block' or 'shed', got "
+                f"{self.shed_policy!r}"
+            )
+        if self.preempt_budget < 0:
+            raise ValueError(
+                f"preempt_budget must be >= 0, got {self.preempt_budget}"
             )
 
 
@@ -216,6 +249,18 @@ class FleetReport:
     # here — which replica is closest to the memory cliff, by semantic
     # owner, without a new wire channel
     hbm_watermarks: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict
+    )
+    # per-priority-class accounting on the ROUTER clock (PR 17): volume,
+    # terminal mix, and TTFT/TPOT percentile blocks per class — the
+    # numbers the premium-isolation gate and per-tenant SLO evaluation
+    # read.  The unlabeled blocks above remain the all-traffic aggregate.
+    per_class: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # per-class latency from the bucket-merged WORKER histograms
+    # (`serve.ttft_s.<class>` ...) — the scheduler-clock counterpart of
+    # per_class's router-clock percentiles, and what per-tenant SLOSpec
+    # evaluation reads (obs.fleet.evaluate_class_slos)
+    fleet_latency_per_class: Dict[str, Any] = dataclasses.field(
         default_factory=dict
     )
 
@@ -507,6 +552,11 @@ def _worker_main(
                     max_new_tokens=msg.get("max_new_tokens"),
                     deadline_s=msg.get("deadline_s"),
                     trace_id=msg.get("trace_id"),
+                    # SLO identity crosses the wire with every delivery
+                    # (redeliveries included) — the worker's priority
+                    # queue and preemption ladder depend on it
+                    tenant=msg.get("tenant", "default"),
+                    priority=msg.get("priority", "standard"),
                 )
             )
         return None if (closed and not fresh) else fresh
@@ -593,6 +643,9 @@ def _worker_main(
         max_new_tokens=spec.max_new_tokens,
         request_deadline_s=spec.request_deadline_s,
         watchdog_deadline_s=spec.watchdog_deadline_s,
+        priority_classes=spec.priority_classes,
+        shed_policy=spec.shed_policy,
+        preempt_budget=spec.preempt_budget,
         # every result streams out through on_complete as it lands; the
         # worker may live for days, so it keeps only a window for its
         # exit report instead of every token it ever generated
@@ -989,7 +1042,11 @@ class FleetRouter:
     # -- serving -----------------------------------------------------------
 
     def serve(
-        self, requests: Sequence[Request], *, shutdown: bool = True
+        self,
+        requests: Sequence[Request],
+        *,
+        shutdown: bool = True,
+        poll: Optional[Callable[[], Optional[List[Request]]]] = None,
     ) -> tuple[List[CompletedRequest], FleetReport]:
         """Serve every request across the fleet; returns (results, report).
 
@@ -1001,6 +1058,15 @@ class FleetRouter:
         recompile) — the multi-batch shape :meth:`reload` slots between:
         serve batch A, reload the fleet's weights, serve batch B on the
         same processes.
+
+        ``poll`` is the router-level live source (same contract as the
+        scheduler's: a list of fresh requests, or None = source closed)
+        — :func:`..serve.traffic.poll_source` adapts a traffic schedule
+        into one.  It is consulted only once at least one replica is
+        READY, so a wall-clock schedule starts when the fleet can
+        actually serve (jax import + engine build don't eat the
+        schedule) — poll_source's clock starting at its first call is
+        the other half of this contract.
         """
         trace = get_tracer()
         router_epoch_unix_s = trace.epoch_unix_s
@@ -1014,15 +1080,32 @@ class FleetRouter:
         backlog: List[str] = []  # uids waiting for a live replica
         results: List[CompletedRequest] = []
         finish_reasons: Dict[str, int] = {}
-        now = time.perf_counter()
-        for i, req in enumerate(requests):
-            if req.uid in flights:
-                raise ValueError(f"duplicate request uid {req.uid!r}")
-            if _SEP in req.uid:
-                raise ValueError(
-                    f"request uid {req.uid!r} contains the reserved "
-                    "delivery separator"
+        # class rank for dispatch ordering / class-weighted load (lower
+        # rank = higher priority); unknown classes sort LAST and the
+        # worker's own admission validation rejects them with a clear
+        # per-request error
+        class_rank = {
+            c: i for i, c in enumerate(self.spec.priority_classes)
+        }
+        n_classes = len(self.spec.priority_classes)
+        intake_n = [0]
+
+        def admit(req: Request, *, strict: bool) -> None:
+            """Mint the flight + backlog entry for one request.  Upfront
+            requests keep the raising contract (caller bug); polled
+            duplicates are logged and skipped — a raise mid-loop would
+            kill the router over one bad source entry."""
+            if req.uid in flights or _SEP in req.uid:
+                problem = (
+                    "duplicate request uid" if req.uid in flights
+                    else "uid contains the reserved delivery separator"
                 )
+                if strict:
+                    raise ValueError(f"{problem}: {req.uid!r}")
+                logger.warning("polled request dropped (%s): %r",
+                               problem, req.uid)
+                return
+            arrived = time.perf_counter()
             deadline_s = (
                 req.deadline_s
                 if req.deadline_s is not None
@@ -1030,22 +1113,27 @@ class FleetRouter:
             )
             flights[req.uid] = _Flight(
                 req=req,
-                submitted_at=now,
+                submitted_at=arrived,
                 # trace id minted at ROUTER INTAKE (honoring a caller-
                 # supplied one): the single correlation key every
                 # delivery, every worker span and every recovery event
                 # carries — distinct from the uid so propagation, not
                 # coincidence, is what the merged timeline shows
-                trace_id=req.trace_id or f"tr{i:04d}",
+                trace_id=req.trace_id or f"tr{intake_n[0]:04d}",
                 deadline_at=(
-                    now + deadline_s if deadline_s is not None else None
+                    arrived + deadline_s if deadline_s is not None else None
                 ),
             )
+            intake_n[0] += 1
             trace.event(
                 "fleet/request_admitted", cat="fleet", uid=req.uid,
+                tenant=req.tenant, priority=req.priority,
                 trace=flights[req.uid].trace_id,
             )
             backlog.append(req.uid)
+
+        for req in requests:
+            admit(req, strict=True)
 
         def finalize(uid: str, payload: Dict[str, Any]) -> None:
             """Stitch a terminal result into the router view (idempotent:
@@ -1079,17 +1167,33 @@ class FleetRouter:
                 total_s=round(done_at - fl.submitted_at, 6),
                 error=payload.get("error"),
                 queue_wait_s=payload.get("queue_wait_s", 0.0),
+                # SLO identity from the FLIGHT (authoritative — router-
+                # synthesized terminals have no worker payload to read);
+                # shed backoff hint and preemption count ride the worker
+                # payload when present
+                tenant=fl.req.tenant,
+                priority=fl.req.priority,
+                retry_after_s=payload.get("retry_after_s"),
+                preemptions=payload.get("preemptions", 0),
             )
             results.append(res)
             finish_reasons[res.finish_reason] = (
                 finish_reasons.get(res.finish_reason, 0) + 1
             )
 
-        def redeliver(uid: str, why: str, avoid: Optional[int]) -> None:
+        def redeliver(
+            uid: str, why: str, avoid: Optional[int],
+            *, shed: bool = False, retry_after_s: Optional[float] = None,
+        ) -> None:
             """Requeue one in-flight uid after a replica death or a shed
             — at most ``max_redeliveries`` retries, the current stream
             committed into ``preserved`` so the retry continues the
-            sequence bit-identically."""
+            sequence bit-identically.  ``shed=True`` marks an admission-
+            time shed: if the retry budget is ALSO spent the request
+            finishes terminal ``"shed"`` (an accounted, intentional
+            rejection with a backoff hint) rather than a lost
+            ``"error"`` — nothing was lost, the whole fleet is just
+            overloaded and the client is told when to come back."""
             fl = flights[uid]
             if fl.done:
                 return  # completion already raced in — nothing to redo
@@ -1123,6 +1227,21 @@ class FleetRouter:
                 })
                 return
             if fl.delivery - 1 >= self.max_redeliveries:
+                if shed:
+                    trace.event(
+                        "fleet/request_shed", cat="fleet", uid=uid,
+                        reason=why, trace=fl.trace_id,
+                    )
+                    finalize(uid, {
+                        "tokens": [],
+                        "finish_reason": "shed",
+                        "error": (
+                            f"shed fleet-wide after {why} "
+                            f"({self.max_redeliveries} retries)"
+                        ),
+                        "retry_after_s": retry_after_s,
+                    })
+                    return
                 self.lost_requests += 1
                 trace.event(
                     "fleet/request_lost", cat="fleet", uid=uid, reason=why,
@@ -1167,6 +1286,11 @@ class FleetRouter:
                 # fault-free stream exactly (decode == full forward)
                 "prompt": list(fl.req.prompt) + fl.preserved,
                 "max_new_tokens": budget - len(fl.preserved),
+                # priority propagates on EVERY delivery, redeliveries
+                # included — a premium failover must not resume as an
+                # anonymous "standard" request on the new replica
+                "tenant": fl.req.tenant,
+                "priority": fl.req.priority,
                 # only the REMAINING window: the worker re-bases from its
                 # own arrival clock, so shipping the raw relative value
                 # would hand every redelivery a fresh full deadline
@@ -1214,6 +1338,8 @@ class FleetRouter:
                     self.shed_seen += 1
                     redeliver(
                         fl.req.uid, f"shed by replica {rid}", avoid=rid,
+                        shed=True,
+                        retry_after_s=payload.get("retry_after_s"),
                     )
                     return
                 finalize(fl.req.uid, payload)
@@ -1338,9 +1464,30 @@ class FleetRouter:
         # timeout (the router's idle wait, not a device sync) — the
         # AST host-sync checker scans this region (sync budget 0) like
         # the trainer/scheduler loops; see analysis/regions.py.
+        # live router source: stays truthy while poll can still produce
+        # requests — the loop condition keeps running even when every
+        # admitted flight has finished
+        more = poll is not None
         try:
-            while len(results) < len(flights):
+            while len(results) < len(flights) or more:
                 live = [m for m in self._members if not m.dead]
+                if more:
+                    if self._drain_event.is_set() or not live:
+                        # draining (new arrivals would be preempted
+                        # unserved) or fleet dead (nothing will ever
+                        # serve them): close the source
+                        more = False
+                    elif any(m.ready for m in live):
+                        # consult the source only once somebody can
+                        # serve: poll_source starts its schedule clock
+                        # at the first call, so spawn/import/compile
+                        # time never eats the traffic schedule
+                        fresh = poll()
+                        if fresh is None:
+                            more = False
+                        else:
+                            for req in fresh:
+                                admit(req, strict=False)
                 if self._drain_event.is_set() and backlog:
                     # router-held work the drain will never admit: hand it to
                     # the control plane's resubmit path.  NOT one-shot — a
@@ -1376,7 +1523,28 @@ class FleetRouter:
                     # live replica idles (holding at the router keeps the
                     # choice open until somebody can actually serve)
                     ready = [m for m in live if m.ready]
-                    for uid in backlog:
+
+                    def rank_of(uid: str) -> int:
+                        return class_rank.get(
+                            flights[uid].req.priority, n_classes - 1
+                        )
+
+                    def member_load(m: _Replica) -> int:
+                        # class-WEIGHTED load: each outstanding request
+                        # counts 2^(classes below it) — one premium
+                        # outweighs any backlog of best_effort, so the
+                        # least-loaded choice is really "least loaded
+                        # with work that matters".  Single-class fleets
+                        # degrade to the old outstanding-count exactly.
+                        return sum(
+                            1 << (n_classes - 1 - rank_of(ouid))
+                            for ouid in m.outstanding
+                        )
+
+                    # dispatch in class order (stable: FIFO within a
+                    # class) — the router-side half of "higher class
+                    # always dequeues first"
+                    for uid in sorted(backlog, key=rank_of):
                         fl = flights[uid]
                         if (
                             fl.deadline_at is not None
@@ -1396,18 +1564,32 @@ class FleetRouter:
                             m for m in ready if m.index != fl.avoid
                         ] or ready  # avoid the shedder unless it is all we have
                         target = min(
-                            pool, key=lambda m: (len(m.outstanding), m.index)
+                            pool,
+                            key=lambda m: (
+                                member_load(m), len(m.outstanding), m.index,
+                            ),
                         )
                         # cap in-flight per replica at slots + a small ready
                         # queue: enough to keep the worker's admission loop
                         # fed, small enough that a death orphans (and redoes)
-                        # at most one batch's worth of work
-                        if len(target.outstanding) >= self.spec.batch_slots + 2:
+                        # at most one batch's worth of work.  Only SAME-OR-
+                        # HIGHER-class outstanding work counts against the
+                        # cap: lower-class work is preemptible on arrival,
+                        # so a best_effort backlog must not stop a premium
+                        # delivery from reaching the worker where the
+                        # preemption ladder lives.  (Single-class traffic:
+                        # identical to the old all-outstanding cap.)
+                        my_rank = rank_of(uid)
+                        blocking = sum(
+                            1 for ouid in target.outstanding
+                            if rank_of(ouid) <= my_rank
+                        )
+                        if blocking >= self.spec.batch_slots + 2:
                             held.append(uid)  # every replica saturated: hold
                             continue
                         deliver(target, uid)
                     backlog[:] = held
-                if len(results) >= len(flights):
+                if len(results) >= len(flights) and not more:
                     break
                 # messages a concurrent reload()'s idle pump read off the
                 # outbox before this loop started are re-dispatched first
@@ -1510,6 +1692,37 @@ class FleetRouter:
         ]
         merged_registry = merge_states(metric_states)
         router_dumps = get_recorder().drain_dumps()
+        # per-class rollup on the router clock: the same
+        # completed-ok/TTFT/TPOT filters as the aggregates above, split
+        # by the class each result carries
+        per_class: Dict[str, Any] = {}
+        for r in results:
+            blk = per_class.setdefault(r.priority, {
+                "requests": 0, "completed_ok": 0, "errors": 0,
+                "shed": 0, "preempted": 0, "preemptions": 0,
+                "finish_reasons": {}, "_ttft": [], "_tpot": [],
+            })
+            blk["requests"] += 1
+            blk["finish_reasons"][r.finish_reason] = (
+                blk["finish_reasons"].get(r.finish_reason, 0) + 1
+            )
+            blk["preemptions"] += r.preemptions
+            if r.finish_reason in ("eos", "length"):
+                blk["completed_ok"] += 1
+                blk["_ttft"].append(r.ttft_s)
+                if len(r.tokens) >= 2:
+                    blk["_tpot"].append(
+                        (r.total_s - r.ttft_s) / (len(r.tokens) - 1)
+                    )
+            elif r.finish_reason == "error":
+                blk["errors"] += 1
+            elif r.finish_reason == "shed":
+                blk["shed"] += 1
+            elif r.finish_reason == "preempted":
+                blk["preempted"] += 1
+        for blk in per_class.values():
+            blk["ttft_s"] = summarize(blk.pop("_ttft"))
+            blk["tpot_s"] = summarize(blk.pop("_tpot"))
         report = FleetReport(
             replicas=self.replicas,
             requests=len(flights),
@@ -1539,8 +1752,12 @@ class FleetRouter:
             replica_metric_states=metric_states,
             fleet_metrics=merged_registry.snapshot(),
             fleet_latency=fleet_latency(merged_registry),
+            fleet_latency_per_class=fleet_latency_per_class(
+                merged_registry
+            ),
             flight_recorder_dumps=router_dumps + self._worker_dumps,
             hbm_watermarks=_hbm_watermarks(metric_states),
+            per_class=per_class,
         )
         reg = get_registry()
         reg.counter("fleet.replica_deaths").inc(self.replica_deaths)
@@ -1560,6 +1777,7 @@ def serve_fleet(
     heartbeat_timeout_s: Optional[float] = None,
     faults: Optional[str] = None,
     install_signals: bool = False,
+    poll: Optional[Callable[[], Optional[List[Request]]]] = None,
 ) -> tuple[List[CompletedRequest], FleetReport]:
     """One-call fleet serving (the ``ddlt serve --replicas N`` body)."""
     router = FleetRouter(
@@ -1572,4 +1790,4 @@ def serve_fleet(
     )
     if install_signals:
         router.install_signal_handler()
-    return router.serve(requests)
+    return router.serve(requests, poll=poll)

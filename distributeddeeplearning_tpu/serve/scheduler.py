@@ -116,22 +116,33 @@ class Request:
     router mints at intake and carries across the worker boundary: every
     request-scoped span/event the scheduler emits is tagged with it, so
     a failover (death on one replica, completion on another) reads as
-    ONE chain in the merged fleet timeline."""
+    ONE chain in the merged fleet timeline.
+
+    ``tenant``/``priority`` are the multi-tenant SLO-class identity: the
+    scheduler dequeues higher classes first, sheds the lowest class
+    first under overload, and preempts lower-class decodes for a blocked
+    higher-class head (see ``priority_classes`` on the scheduler).  The
+    defaults keep single-tenant callers exactly where they were."""
 
     uid: str
     prompt: Sequence[int]
     max_new_tokens: Optional[int] = None
     deadline_s: Optional[float] = None
     trace_id: Optional[str] = None
+    tenant: str = "default"
+    priority: str = "standard"
 
 
 #: terminal states a request can reach (``CompletedRequest.finish_reason``)
 FINISH_REASONS = (
     "eos", "length", "error", "step_cap", "cancelled",
     "deadline",   # request ran past its deadline (partial tokens kept)
-    "shed",       # admission rejected under overload (reject_admit fault
-    #               or router-level backpressure) — safe to retry elsewhere
-    "preempted",  # drain: the scheduler is shutting down; never started
+    "shed",       # admission rejected under overload (reject_admit fault,
+    #               priority-aware load shedding, or router-level
+    #               backpressure) — safe to retry elsewhere / later
+    "preempted",  # drain (scheduler shutting down) or priority preemption
+    #               with the per-request preemption budget spent; promises
+    #               NO tokens — the control plane resubmits the request
 )
 
 
@@ -145,6 +156,14 @@ class CompletedRequest:
     total_s: float
     error: Optional[str] = None  # set when finish_reason == "error"
     queue_wait_s: float = 0.0  # arrival -> admission (scheduler latency)
+    tenant: str = "default"
+    priority: str = "standard"
+    # "shed" results only: the scheduler's estimate of when capacity
+    # frees (seconds) — the client-side backoff hint
+    retry_after_s: Optional[float] = None
+    # lossless priority preemptions this request survived (each one cut
+    # its decode and resumed it bit-identically elsewhere in the queue)
+    preemptions: int = 0
 
 
 @dataclasses.dataclass
@@ -171,6 +190,10 @@ class _ReqMeta:
     ttft_s: Optional[float] = None
     queue_wait_s: Optional[float] = None
     decode_retries: int = 0
+    # lossless priority preemptions consumed (budgeted SEPARATELY from
+    # decode_retries: a preemption is scheduler policy, not a failure,
+    # and must never eat a request's failure-recovery life)
+    preemptions: int = 0
 
 
 @dataclasses.dataclass
@@ -241,6 +264,12 @@ class ServeReport:
     # readback, per spec step (zero-filled blocks on non-spec runs)
     draft_step_s: Dict[str, float] = dataclasses.field(default_factory=dict)
     verify_step_s: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # multi-tenant overload accounting (PR 17): per-priority-class
+    # latency/volume blocks — the UNLABELED blocks above stay the
+    # all-traffic aggregate for committed-artifact schema compatibility
+    # — plus the lossless-preemption event count
+    per_class: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    preemptions: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -292,6 +321,43 @@ def synthetic_requests(
 _percentiles = summarize
 
 
+class _PriorityQueue:
+    """Strict-priority pending queue, deque-shaped where the serve loop
+    touches it: ``append`` routes by the request's class, ``popleft`` /
+    ``[0]`` serve the head of the highest non-empty class, and
+    ``appendleft`` returns a request to the FRONT of its own class — a
+    requeued/preempted retry resumes ahead of its class peers but never
+    jumps class.  Within a class, FIFO order is untouched, so an
+    all-one-class workload behaves exactly like the old plain deque."""
+
+    def __init__(self, rank: Dict[str, int]):
+        self._rank = rank
+        self._qs: List[deque] = [deque() for _ in rank]
+
+    def append(self, req: Request) -> None:
+        self._qs[self._rank[req.priority]].append(req)
+
+    def appendleft(self, req: Request) -> None:
+        self._qs[self._rank[req.priority]].appendleft(req)
+
+    def popleft(self) -> Request:
+        for q in self._qs:
+            if q:
+                return q.popleft()
+        raise IndexError("pop from empty _PriorityQueue")
+
+    def __getitem__(self, idx: int) -> Request:
+        if idx != 0:
+            raise IndexError("only the head ([0]) is addressable")
+        for q in self._qs:
+            if q:
+                return q[0]
+        raise IndexError("empty _PriorityQueue")
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._qs)
+
+
 class ContinuousBatchingScheduler:
     """Drive an :class:`InferenceEngine` over a stream of requests."""
 
@@ -308,6 +374,12 @@ class ContinuousBatchingScheduler:
         result_window: Optional[int] = None,
         spec_decoder=None,
         hbm_ledger="auto",
+        priority_classes: Sequence[str] = (
+            "premium", "standard", "best_effort",
+        ),
+        shed_policy: str = "block",
+        preempt_budget: int = 2,
+        shed_patience: int = 3,
     ):
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
@@ -368,6 +440,49 @@ class ContinuousBatchingScheduler:
         # no capacity configured (the CPU mesh) the check is one
         # attribute read.
         self.hbm_ledger = hbm_ledger
+        # multi-tenant SLO classes (PR 17), highest priority FIRST: the
+        # queue dequeues higher classes first, admission sheds the LAST
+        # class first (shed_policy="shed"), and a blocked higher-class
+        # head preempts the lowest-class active decode losslessly, up to
+        # preempt_budget cuts per request — the budget spent, the victim
+        # finishes terminal "preempted" (graceful starvation, never a
+        # livelock).  Requests default to priority "standard", so the
+        # default tuple keeps single-tenant callers byte-identical.
+        classes = tuple(priority_classes)
+        if not classes or any(
+            not isinstance(c, str) or not c for c in classes
+        ):
+            raise ValueError(
+                "priority_classes must be a non-empty sequence of "
+                f"non-empty class names, got {priority_classes!r}"
+            )
+        if len(set(classes)) != len(classes):
+            raise ValueError(
+                f"duplicate priority classes in {priority_classes!r}"
+            )
+        if shed_policy not in ("block", "shed"):
+            raise ValueError(
+                f"shed_policy must be 'block' or 'shed', got {shed_policy!r}"
+            )
+        if preempt_budget < 0:
+            raise ValueError(
+                f"preempt_budget must be >= 0, got {preempt_budget}"
+            )
+        if shed_patience < 0:
+            raise ValueError(
+                f"shed_patience must be >= 0, got {shed_patience}"
+            )
+        self.priority_classes = classes
+        self.shed_policy = shed_policy
+        self.preempt_budget = preempt_budget
+        # consecutive blocked iterations a lowest-class head endures
+        # before shedding while work is in flight: memory pressure is
+        # often TRANSIENT (a completion two decode steps away frees the
+        # pages), and a shed against one instantaneous reading throws
+        # away a request that would have been admitted milliseconds
+        # later.  0 = shed on first blocked pass.
+        self.shed_patience = shed_patience
+        self._class_rank = {c: i for i, c in enumerate(classes)}
         self._cancelled: set = set()
         # live weight reload (serve/fleet.py): a callable applied at the
         # next IDLE BARRIER — single attribute store/load, so setting it
@@ -414,6 +529,31 @@ class ContinuousBatchingScheduler:
         if st.next_pos >= self.engine.max_seq:
             return "length"  # cache full — no position left to write
         return None
+
+    def _preemption_victim(
+        self, active: Dict[int, "_SlotState"], head_rank: int
+    ) -> Optional[int]:
+        """Pick the active slot to cut for a blocked head of class rank
+        ``head_rank``: the LOWEST class strictly below the head (never a
+        peer — same-class traffic queues, it does not cannibalize), and
+        within that class the slot with the LEAST streamed progress (the
+        cheapest resume) — slot index breaks exact ties
+        deterministically.  None = nothing strictly lower is decoding.
+
+        Registered hot region (analysis/regions.py, sync budget 0): the
+        decision rides signals already on host — class ranks, generated-
+        token counts, slot ids — and must never grow a device readback.
+        """
+        victim = None
+        victim_key = None
+        for slot, st in active.items():
+            rank = self._class_rank.get(st.req.priority)
+            if rank is None or rank <= head_rank:
+                continue
+            key = (-rank, len(st.generated), slot)
+            if victim_key is None or key < victim_key:
+                victim, victim_key = slot, key
+        return victim
 
     def run(
         self,
@@ -521,10 +661,37 @@ class ContinuousBatchingScheduler:
         spec_slot_steps = 0
         finish_reasons: Dict[str, int] = {}
         meta: Dict[str, _ReqMeta] = {}
+        # per-priority-class accounting (PR 17): local histograms feed the
+        # report's per_class blocks; the lazily-bound registry histograms
+        # (`serve.ttft_s.<class>` etc.) ride the periodic metric ship so
+        # fleet-merged percentiles can split tails by class.  The
+        # UNLABELED aggregates stay authoritative for committed-artifact
+        # schema compatibility.
+        class_stats: Dict[str, Dict[str, Any]] = {}
+        class_registry_hists: Dict[str, Any] = {}
+
+        def class_bucket(priority: str) -> Dict[str, Any]:
+            cs = class_stats.get(priority)
+            if cs is None:
+                cs = class_stats[priority] = {
+                    "requests": 0,
+                    "preemptions": 0,
+                    "ttft": Histogram(f"serve.ttft_s.{priority}"),
+                    "tpot": Histogram(f"serve.tpot_s.{priority}"),
+                    "qwait": Histogram(f"serve.queue_wait_s.{priority}"),
+                    "finish_reasons": {},
+                }
+                class_registry_hists[priority] = (
+                    _reg.histogram(f"serve.ttft_s.{priority}"),
+                    _reg.histogram(f"serve.tpot_s.{priority}"),
+                    _reg.histogram(f"serve.queue_wait_s.{priority}"),
+                )
+            return cs
 
         error_count = 0
         quarantined = 0
         decode_retries = 0
+        preempted_events = 0
 
         def budget_of(req: Request) -> int:
             return (
@@ -548,15 +715,34 @@ class ContinuousBatchingScheduler:
             # (Failures with no tokens carry a hardcoded ttft_s=0.0 and
             # would drag the histogram toward 0 — same filters the
             # report blocks use.)
+            cs = class_bucket(result.priority)
+            cs["requests"] += 1
+            cs["finish_reasons"][result.finish_reason] = (
+                cs["finish_reasons"].get(result.finish_reason, 0) + 1
+            )
+            reg_ttft, reg_tpot, reg_qwait = class_registry_hists[
+                result.priority
+            ]
             if result.tokens:
                 ttft_registry_hist.record(result.ttft_s)
+                cs["ttft"].record(result.ttft_s)
+                reg_ttft.record(result.ttft_s)
             if len(result.tokens) >= 2 and result.finish_reason not in (
                 "cancelled", "preempted",
             ):
-                tpot_registry_hist.record(
-                    (result.total_s - result.ttft_s)
-                    / (len(result.tokens) - 1)
+                tpot_v = (result.total_s - result.ttft_s) / (
+                    len(result.tokens) - 1
                 )
+                tpot_registry_hist.record(tpot_v)
+                cs["tpot"].record(tpot_v)
+                reg_tpot.record(tpot_v)
+            # same filter as the report's aggregate queue_wait block: a
+            # never-admitted terminal state has no admission to wait for
+            if result.finish_reason not in (
+                "cancelled", "preempted", "shed", "deadline",
+            ):
+                cs["qwait"].record(result.queue_wait_s)
+                reg_qwait.record(result.queue_wait_s)
             if pop_meta:
                 # the uid is terminal: its cross-delivery bookkeeping is
                 # dead weight from here on (a long-lived live loop would
@@ -598,6 +784,9 @@ class ContinuousBatchingScheduler:
                         if m.queue_wait_s is not None
                         else st.queue_wait_s
                     ),
+                    tenant=st.req.tenant,
+                    priority=st.req.priority,
+                    preemptions=m.preemptions,
                 )
             )
             if reason == "error":
@@ -615,6 +804,7 @@ class ContinuousBatchingScheduler:
             req: Request, exc: Optional[BaseException],
             queue_wait: float = 0.0, reason: str = "error",
             error: Optional[str] = None,
+            retry_after: Optional[float] = None,
         ) -> None:
             """Per-request fault isolation: record the failure, keep serving.
 
@@ -656,6 +846,10 @@ class ContinuousBatchingScheduler:
                         else None
                     ),
                     queue_wait_s=queue_wait,
+                    tenant=req.tenant,
+                    priority=req.priority,
+                    retry_after_s=retry_after,
+                    preemptions=m.preemptions if m is not None else 0,
                 )
             )
             if reason == "error":
@@ -719,6 +913,8 @@ class ContinuousBatchingScheduler:
                     total_s=0.0,
                     error="duplicate uid while the first copy is still "
                     "in flight — rejected at admission",
+                    tenant=req.tenant,
+                    priority=req.priority,
                 ), pop_meta=False)
                 return False
             deadline_s = (
@@ -752,6 +948,19 @@ class ContinuousBatchingScheduler:
                 fail_request(
                     req, None,
                     error="empty prompt rejected at admission",
+                )
+                return False
+            if req.priority not in self._class_rank:
+                # the priority queue routes by class rank — an unknown
+                # class has no lane; reject with the serving vocabulary
+                # instead of KeyError-ing the loop
+                fail_request(
+                    req, None,
+                    error=(
+                        f"unknown priority class {req.priority!r} (this "
+                        f"scheduler serves {self.priority_classes}) — "
+                        "rejected at admission"
+                    ),
                 )
                 return False
             max_seq = getattr(engine, "max_seq", None)
@@ -805,6 +1014,11 @@ class ContinuousBatchingScheduler:
                 prompt=list(st.req.prompt) + list(st.generated),
                 max_new_tokens=st.budget - len(st.generated),
                 trace_id=st.req.trace_id,
+                # the retry keeps its SLO identity — dropping these would
+                # silently demote a premium request to "standard" exactly
+                # when it is being retried after a fault
+                tenant=st.req.tenant,
+                priority=st.req.priority,
             )
             del active[slot]
             release(slot)
@@ -815,7 +1029,123 @@ class ContinuousBatchingScheduler:
                 preserved_tokens=len(m.preserved), trace=st.req.trace_id,
             )
 
-        pending: deque = deque()
+        def retry_after_hint() -> float:
+            """Backoff hint attached to a "shed" result: the soonest any
+            active slot can free (remaining token budget x mean decode-
+            step wall so far), clamped to a sane client backoff window.
+            Host math over state already in hand — no device sync."""
+            if not active:
+                return 1.0
+            avg = decode_wall / n_decode_steps if n_decode_steps else 0.05
+            soonest = min(
+                st.budget - len(st.generated) for st in active.values()
+            )
+            return round(min(30.0, max(0.05, soonest * avg)), 3)
+
+        def preempt_slot(slot: int, st: _SlotState) -> None:
+            """Cut the lowest-class active decode for a blocked higher-
+            class head.  Within the per-request budget the cut is
+            LOSSLESS — exactly the PR 7 requeue shape: the retry's prompt
+            is the original prompt plus every token already streamed, its
+            budget is the remainder, so a greedy resume continues
+            bit-identically (decode is pinned bit-exact against the full
+            forward); the retry rejoins the FRONT of its own class and
+            the slot frees through the normal ``release`` path, so shared
+            prefix pages keep their refcounts (never scrubbed — scrub is
+            for quarantine, not policy).  Budget spent: the victim
+            finishes terminal "preempted" with NO tokens — graceful
+            starvation; every cut either frees capacity for the head or
+            retires the victim, so the loop can never livelock."""
+            nonlocal preempted_events
+            m = meta[st.req.uid]
+            if m.preemptions >= self.preempt_budget:
+                del active[slot]
+                release(slot)
+                free.append(slot)
+                fail_request(
+                    st.req, None, queue_wait=st.queue_wait_s,
+                    reason="preempted",
+                    error=(
+                        f"preemption budget ({self.preempt_budget}) spent "
+                        "under sustained higher-class load"
+                    ),
+                )
+                return
+            m.preemptions += 1
+            preempted_events += 1
+            class_bucket(st.req.priority)["preemptions"] += 1
+            if m.ttft_s is None and st.generated:
+                m.ttft_s = st.ttft_s
+                m.queue_wait_s = st.queue_wait_s
+            m.preserved = m.preserved + list(st.generated)
+            retry = Request(
+                uid=st.req.uid,
+                prompt=list(st.req.prompt) + list(st.generated),
+                max_new_tokens=st.budget - len(st.generated),
+                trace_id=st.req.trace_id,
+                tenant=st.req.tenant,
+                priority=st.req.priority,
+            )
+            del active[slot]
+            release(slot)
+            free.append(slot)
+            pending.appendleft(retry)
+            trace.event(
+                "serve/request_preempted", uid=st.req.uid,
+                preserved_tokens=len(m.preserved),
+                preemptions=m.preemptions, trace=st.req.trace_id,
+            )
+
+        shed_wait = {"uid": None, "passes": 0}
+
+        def maybe_shed(req: Request) -> bool:
+            """Admission-time load shedding: ONLY the lowest class (a
+            premium/standard head can never shed — it blocks, preempts,
+            or times out), ONLY under memory pressure (plain slot
+            queueing is ordinary priority queueing, not overload), and
+            ONLY when the policy opted in.  The "shed" result carries a
+            ``retry_after_s`` backoff hint.
+
+            Two additional guards keep the valve from over-relieving:
+
+            - a requeued PREEMPTED stream is never shed — preemption is
+              lossless by contract, so resumed work either completes or
+              retires terminal "preempted" when its budget is spent; it
+              does not get thrown away at the admission gate;
+            - while work is in flight, the head must stay blocked for
+              ``shed_patience`` consecutive iterations first — pressure
+              a completion can relieve within a few decode steps is not
+              overload.  With NOTHING in flight the pressure cannot
+              self-resolve, so the head sheds immediately.
+            """
+            if self.shed_policy != "shed":
+                return False
+            if self._class_rank[req.priority] != len(
+                self.priority_classes
+            ) - 1:
+                return False
+            m = meta[req.uid]
+            if m.preemptions or m.preserved:
+                return False
+            if active or prefilling:
+                if shed_wait["uid"] != req.uid:
+                    shed_wait["uid"] = req.uid
+                    shed_wait["passes"] = 0
+                shed_wait["passes"] += 1
+                if shed_wait["passes"] <= self.shed_patience:
+                    return False
+            shed_wait["uid"] = None
+            shed_wait["passes"] = 0
+            pending.popleft()
+            fail_request(
+                req, None, reason="shed",
+                error="admission shed under memory pressure (lowest "
+                "priority class goes first)",
+                retry_after=retry_after_hint(),
+            )
+            return True
+
+        pending = _PriorityQueue(self._class_rank)
         for req in requests:
             intake(req)
 
@@ -943,6 +1273,23 @@ class ContinuousBatchingScheduler:
                 # Paged engines additionally gate on free PAGES: a request that
                 # could strand mid-decode is left queued (backpressure) until
                 # completions free its reservation.
+                # priority preemption on SLOT pressure: a higher-class
+                # head stuck behind zero free slots cuts the lowest-class
+                # active decode (losslessly, budget permitting) instead
+                # of waiting out the victim's full token budget.  One cut
+                # per iteration — pressure relief is gradual by design.
+                # Page/HBM pressure is handled inside the admission loop
+                # below, where the blocked resource is known.
+                if (
+                    pending and not free and not draining
+                    and self._pending_reload is None
+                ):
+                    head_rank = self._class_rank.get(pending[0].priority)
+                    if head_rank is not None:
+                        victim = self._preemption_victim(active, head_rank)
+                        if victim is not None:
+                            preempt_slot(victim, active[victim])
+
                 hbm_committed = None  # ledger walk amortized per iteration
                 while (
                     pending and not draining and free
@@ -978,6 +1325,24 @@ class ContinuousBatchingScheduler:
                             ))
                             continue
                         if not engine.can_admit(len(req.prompt), budget):
+                            # PAGE pressure: cut a strictly-lower-class
+                            # decode (its pages release) and re-check;
+                            # no victim -> shed the head if it is
+                            # lowest-class and the policy allows
+                            victim = self._preemption_victim(
+                                active, self._class_rank[req.priority]
+                            )
+                            if victim is not None:
+                                preempt_slot(victim, active[victim])
+                                continue
+                            # ONE shed per iteration, then yield to the
+                            # decode step: shedding relieves pressure for
+                            # the head, it must not cascade through the
+                            # whole queue against one instantaneous
+                            # reading while in-flight completions are a
+                            # few steps from freeing the pages
+                            if maybe_shed(req):
+                                break
                             if active or prefilling:
                                 break  # completions will free pages
                             # nothing in flight can free pages: fail loudly
@@ -1012,6 +1377,24 @@ class ContinuousBatchingScheduler:
                             if not hbm_ledger.admit_ok(
                                 extra, committed=hbm_committed
                             ):
+                                # HBM-forecast pressure: same ladder as
+                                # page pressure — preempt strictly lower,
+                                # then shed a lowest-class head, then
+                                # block on in-flight completions
+                                victim = self._preemption_victim(
+                                    active, self._class_rank[req.priority]
+                                )
+                                if victim is not None:
+                                    preempt_slot(victim, active[victim])
+                                    # the cut released committed bytes;
+                                    # the stale walk must not block the
+                                    # re-check
+                                    hbm_committed = None
+                                    continue
+                                # one shed per iteration (same pacing
+                                # rule as the page ladder above)
+                                if maybe_shed(req):
+                                    break
                                 if active or prefilling:
                                     # completions release committed bytes
                                     break
@@ -1371,6 +1754,20 @@ class ContinuousBatchingScheduler:
             ),
             draft_step_s=draft_hist.summary(),
             verify_step_s=verify_hist.summary(),
+            per_class={
+                cls: {
+                    "requests": cs["requests"],
+                    "ttft_s": cs["ttft"].summary(),
+                    "tpot_s": cs["tpot"].summary(),
+                    "queue_wait_s": cs["qwait"].summary(),
+                    "finish_reasons": dict(cs["finish_reasons"]),
+                    "shed": cs["finish_reasons"].get("shed", 0),
+                    "preempted": cs["finish_reasons"].get("preempted", 0),
+                    "preemptions": cs["preemptions"],
+                }
+                for cls, cs in sorted(class_stats.items())
+            },
+            preemptions=preempted_events,
         )
         # end-of-run rollup into the process metrics registry (one
         # record_many per stream, NOT per step — the hot loop stays hot):
@@ -1381,6 +1778,12 @@ class ContinuousBatchingScheduler:
         reg.counter("serve.errors").inc(error_count)
         reg.counter("serve.decode_retries").inc(decode_retries)
         reg.counter("serve.quarantined").inc(quarantined)
+        # overload-protection counters: lossless preemption EVENTS (one
+        # request may be cut several times) and terminal sheds.  The
+        # per-class ttft/tpot/queue-wait histograms were fed per
+        # completion in finish() — no rollup, same as the aggregates.
+        reg.counter("serve.preemptions").inc(preempted_events)
+        reg.counter("serve.shed").inc(finish_reasons.get("shed", 0))
         # ttft/tpot histograms were fed per completion in finish() —
         # recording them again here would double-count every request
         reg.histogram("serve.decode_step_s").merge(step_hist)

@@ -55,6 +55,21 @@ step** counter, 1-based, per worker process):
                  overload-shedding path; the request finishes ``"shed"``
                  and the fleet router redelivers it elsewhere.
 
+Traffic-shaping kinds (consumed by ``serve/traffic.py`` at schedule
+build — their ``@N`` is the **Nth matching schedule-build opportunity**,
+one per tenant per :meth:`~..serve.traffic.TrafficGenerator.schedule`
+call, because traffic generation has no step context; a ``tenant=``
+option restricts matching to that tenant's builds):
+
+- ``burst``      splice an extra poisson arrival burst into the matched
+                 tenant's schedule — ``rps=`` (burst rate, default 4x the
+                 tenant's base rate), ``secs=`` (burst length, default
+                 1.0), ``at=`` (start offset, default 0.0).  The overload
+                 bench's misbehaving-client injection;
+- ``slow_tenant`` multiply the matched tenant's prompt lengths (and its
+                 per-request token budget, when the spec sets one) by
+                 ``factor=`` (default 4.0) — the straggler-tenant shape.
+
 Checkpoint durability kinds (consumed by ``train/checkpoint.py`` — their
 ``@N`` is **generation-opportunity**-keyed, like ``io_error``'s, because
 storage finalization has no train-step context):
@@ -102,7 +117,7 @@ ENV_VAR = "DDLT_FAULTS"
 KINDS = (
     "nan_loss", "data_stall", "data_death", "preempt", "io_error",
     "replica_death", "decode_nan", "decode_stall", "reject_admit",
-    "ckpt_corrupt", "ckpt_torn",
+    "ckpt_corrupt", "ckpt_torn", "burst", "slow_tenant",
 )
 
 #: kinds the serving stack consumes — the fleet supervisor DEALS these
@@ -381,6 +396,41 @@ class FaultPlan:
                     self._record(spec, spec.step, "reject_admit")
                     return True
         return False
+
+    # -- hook: traffic generation (serve/traffic.py) ---------------------
+
+    def _take_tenant_keyed(
+        self, kind: str, tenant: str
+    ) -> Optional[Dict[str, Any]]:
+        """Consume a one-shot ``kind`` fault at its Nth MATCHING
+        schedule-build opportunity: a ``tenant=`` option restricts
+        matching (and opportunity counting) to that tenant's builds, so
+        ``burst@1:tenant=best_effort`` fires on the best_effort tenant
+        regardless of tenant iteration order."""
+        for spec in self.specs:
+            if spec.kind != kind or spec.fired:
+                continue
+            want = spec.options.get("tenant")
+            if want is not None and str(want) != tenant:
+                continue
+            n = self._io_opportunities.get(id(spec), 0) + 1
+            self._io_opportunities[id(spec)] = n
+            if n >= (spec.step or 1):
+                spec.fired = True
+                self._record(spec, spec.step, f"{kind}:{tenant}")
+                return dict(spec.options)
+        return None
+
+    def take_burst(self, tenant: str) -> Optional[Dict[str, Any]]:
+        """``burst``: overload-injection options for THIS tenant's
+        schedule build (``rps`` / ``secs`` / ``at`` — see module
+        docstring), else None."""
+        return self._take_tenant_keyed("burst", tenant)
+
+    def take_slow_tenant(self, tenant: str) -> Optional[Dict[str, Any]]:
+        """``slow_tenant``: straggler-injection options for THIS tenant's
+        schedule build (``factor`` — see module docstring), else None."""
+        return self._take_tenant_keyed("slow_tenant", tenant)
 
     # -- hook: storage paths ---------------------------------------------
 
