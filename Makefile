@@ -13,7 +13,7 @@ IMAGE ?= ddlt-control
 DATA_DIR ?= /data
 
 .PHONY: install test test-fast lint perf-history obs-gate generate clean \
-        bench-smoke bench scaling dryrun docker-build docker-run \
+        bench-smoke bench scaling bench-tp dryrun docker-build docker-run \
         docker-bash docker-stop
 
 install:
@@ -69,6 +69,12 @@ bench:
 # Allreduce scaling-efficiency sweep (BASELINE.json north-star #2).
 scaling:
 	python bench.py --devices 1,2,4,8 --small
+
+# Tensor-parallel serving benchmark (TP_r{NN}.json): TP=1 vs TP=2 on a
+# virtual pod, gated on bit-identical tokens, per-chip param HBM and the
+# decode roofline.
+bench-tp:
+	python bench.py --tp 2
 
 # Multi-chip sharding dry run on a virtual 8-device pod (the XLA_FLAGS
 # hint lets utils/virtual_pod pin the CPU platform without touching the

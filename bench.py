@@ -42,7 +42,7 @@ V100_TF_CNN_BENCHMARKS_IMG_SEC = 720.0
 #: ``_rNN`` suffix (the drift that left COMMS at r09 while RESILIENCE sat
 #: at r07).  Committed artifacts keep their historical names; NEW runs
 #: write ``<KIND>_r{BENCH_REVISION}.json``.
-BENCH_REVISION = 19
+BENCH_REVISION = 20
 
 
 def artifact_name(kind: str) -> str:
@@ -784,7 +784,7 @@ def _serve_line(report, engine, args, *, max_prompt, mesh=None):
             round(report.kv_bytes_peak / admitted, 2) if admitted else None
         ),
         "mesh_devices": (
-            len(jax.devices()) if mesh is not None else 1
+            int(mesh.devices.size) if mesh is not None else 1
         ),
         "platform": jax.default_backend(),
         "virtual_pod": _is_virtual_pod(),
@@ -1308,6 +1308,305 @@ def _run_quant(args) -> int:
     }
     print(json.dumps(line))
     report_path = args.report or artifact_name("QUANT")
+    with open(report_path, "w") as f:
+        json.dump(line, f, indent=2)
+        f.write("\n")
+    return 0
+
+
+def _run_tp(args) -> int:
+    """Tensor-parallel serving benchmark: TP=1 vs TP=N at FIXED model size
+    (the ``TP_r{NN}.json`` artifact, on a virtual pod off-TPU).
+
+    Two engine layouts at both TP degrees over identical greedy traffic —
+    dense f32 and paged int8, built by ``serve.engine.tensor_parallel_
+    engine`` so every placement (params, KV pages, int8 scale leaves, jit
+    io) resolves through the partition-rule table in
+    ``parallel/sharding.py`` (the artifact records the table's provenance
+    stamp).  Three gates, enforced on full-geometry runs (rc 1):
+
+    - **bit-identical tokens** — the TP=N greedy stream must equal TP=1
+      token-for-token on every config.  Megatron sharding only reorders
+      the reduction through its per-block all-reduce; with the margin-
+      profiled synthetic model (tied 4x-gain embedding head — trained-
+      model top-2 logit gaps) the argmax is invariant, so the gate is
+      exact stream equality, not an agreement rate.
+    - **per-chip param HBM** — ledger-attributed (``obs/ledger``'s
+      sharding-metadata walk, never touching shard data): the max-over-
+      chips param bytes at TP=N must be <= 0.55x the TP=1 figure (~1/N
+      plus the replicated ln/pos slack the table deliberately leaves).
+    - **decode latency** — the per-chip ROOFLINE time of the compiled
+      decode program (post-partitioning ``cost_analysis`` flops/bytes
+      over the ``obs/attrib.reference_peaks`` ceilings — deterministic
+      on the virtual pod, where wall-clock is host-core-contention
+      noise) must be STRICTLY below TP=1 for every config.  Measured
+      decode wall is recorded alongside, labeled informational.
+
+    The TP decode HLO's collective signature is recorded through
+    ``parallel/comms.collective_stats(mesh=...)``, which classifies the
+    per-block tensor all-reduces under ``tp-all-reduce`` — pinned >= 1
+    here (a collective-free TP "win" would mean the weights silently
+    replicated behind the table's back) and kept out of the gradient
+    all-reduce count the comm-path lint audits.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearning_tpu.utils.virtual_pod import (
+        force_cpu_platform_if_virtual_pod,
+        reexec_with_virtual_pod,
+    )
+
+    force_cpu_platform_if_virtual_pod()
+    if len(jax.devices()) < args.tp:
+        # TP needs real shards — same virtual-pod recipe as --devices
+        return reexec_with_virtual_pod(8)
+
+    from distributeddeeplearning_tpu.models.pipelined_transformer import (
+        init_params,
+    )
+    from distributeddeeplearning_tpu.obs import ledger as _ledger
+    from distributeddeeplearning_tpu.obs.attrib import reference_peaks
+    from distributeddeeplearning_tpu.parallel import comms
+    from distributeddeeplearning_tpu.parallel import sharding as _layout
+    from distributeddeeplearning_tpu.serve import (
+        ContinuousBatchingScheduler,
+        synthetic_requests,
+    )
+    from distributeddeeplearning_tpu.serve.engine import (
+        tensor_parallel_engine,
+    )
+    from distributeddeeplearning_tpu.utils.roofline import program_roofline
+
+    tp = args.tp
+    dims = dict(num_layers=4, d_model=512, num_heads=8, d_ff=2048,
+                vocab_size=8192)
+    if args.small:
+        # smoke geometry: the replicated ln/pos leaves dominate a tiny
+        # model, so the per-chip byte and roofline gates are OFF here
+        # (they need the full geometry where matmul weights dominate)
+        dims = dict(num_layers=2, d_model=64, num_heads=4, d_ff=128,
+                    vocab_size=257)
+    max_prompt = max(8, args.seq_len)
+    max_seq = max_prompt + args.max_new_tokens
+    params = init_params(jax.random.key(0), max_len=max_seq, **dims)
+    # trained-model margin profile (same recipe as --quant): tied 4x-gain
+    # embedding head so top-2 logit gaps dwarf the all-reduce's f32
+    # reassociation noise and the bit-identity gate measures the layout,
+    # not argmax tie-breaking
+    params["embed"] = params["embed"] * 4.0
+    params["head"] = params["embed"].T
+
+    def build(kind, tp_n):
+        kw = dict(
+            tp=tp_n, num_heads=dims["num_heads"],
+            batch_slots=args.batch_slots, max_seq=max_seq,
+            temperature=0.0, rng=jax.random.key(1),
+        )
+        if kind == "paged_int8":
+            kw.update(
+                kv_layout="paged", cache_dtype=jnp.int8,
+                page_size=args.page_size, num_pages=args.kv_pages,
+                prefill_chunk=args.prefill_chunk,
+            )
+        engine, _mesh = tensor_parallel_engine(params, **kw)
+        return engine
+
+    requests = synthetic_requests(
+        args.serve_requests, vocab_size=dims["vocab_size"],
+        max_prompt=max_prompt, min_prompt=max(2, max_prompt // 8),
+        rng=np.random.default_rng(0),
+    )
+
+    def run_one(engine):
+        if args.steps_cap is None:
+            _serve_warmup(
+                engine, max_seq, requests, vocab_size=dims["vocab_size"]
+            )
+        results, report = ContinuousBatchingScheduler(
+            engine,
+            max_new_tokens=args.max_new_tokens,
+            step_cap=args.steps_cap,
+        ).run(list(requests))
+        if args.steps_cap is None:
+            assert report.prefill_compiles == 0, (
+                f"warmup missed {report.prefill_compiles} prefill shape(s)"
+            )
+        return {r.uid: r.tokens for r in results}, report
+
+    def per_chip_param_bytes(engine):
+        """{device: params bytes resident} from sharding metadata only
+        (the ledger's accounting walk — obs/ledger._shard_bytes)."""
+        totals = {}
+        for leaf in jax.tree_util.tree_leaves(engine.params):
+            per_shard, devices = _ledger._shard_bytes(leaf)
+            for dev in devices:
+                key = str(dev)
+                totals[key] = totals.get(key, 0) + per_shard
+        return totals
+
+    def _time_decode(engine, steps=5):
+        # min over single dispatches — the noise-robust wall estimate on
+        # a shared host; the decode program is already compiled (the
+        # scheduler run above drove it)
+        tokens = np.ones(engine.batch_slots, np.int32)
+        pos = np.full(engine.batch_slots, 1, np.int32)
+        best = float("inf")
+        for _ in range(steps):
+            t0 = _time.perf_counter()
+            jax.block_until_ready(engine.decode(tokens, pos))
+            best = min(best, _time.perf_counter() - t0)
+        return best
+
+    def decode_program_verdict(engine):
+        """(roofline dict, collective stats) for the compiled decode
+        program: the LAST recorded decode signature re-lowered and
+        AOT-compiled, post-partitioning cost_analysis flops/bytes (the
+        per-chip program — TP=N compiles ~1/N of the matmul work plus
+        its collectives) against the reference chip ceilings."""
+        prog = engine._decode_jit
+        assert prog._sigs, "decode never compiled — the run above is gone"
+        sig_args, sig_kwargs = list(prog._sigs.values())[-1]
+        compiled = prog._fn.lower(*sig_args, **sig_kwargs).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        ca = ca or {}
+        flops = float(ca.get("flops", 0.0) or 0.0)
+        nbytes = float(
+            ca.get("bytes accessed", ca.get("bytes_accessed", 0.0)) or 0.0
+        )
+        peak_tflops, peak_gbps, peak_src = reference_peaks()
+        roofline = program_roofline(
+            flops, nbytes, _time_decode(engine),
+            peak_tflops=peak_tflops, peak_hbm_gbps=peak_gbps,
+        )
+        roofline["peak_source"] = peak_src
+        roofline["measured_note"] = (
+            "measured_s is informational on a virtual pod (host-core "
+            "contention); roofline_s is the gated, deterministic figure"
+        )
+        coll = comms.collective_stats(
+            compiled.as_text(), mesh=engine.mesh
+        )
+        return roofline, coll
+
+    configs = ("dense_f32", "paged_int8")
+    tokens, reports, engines = {}, {}, {}
+    for kind in configs:
+        for tp_n in (1, tp):
+            engine = build(kind, tp_n)
+            tokens[(kind, tp_n)], reports[(kind, tp_n)] = run_one(engine)
+            engines[(kind, tp_n)] = engine
+
+    bit_identical = {
+        kind: tokens[(kind, 1)] == tokens[(kind, tp)] for kind in configs
+    }
+    param_bytes = {
+        f"tp{tp_n}": per_chip_param_bytes(engines[("dense_f32", tp_n)])
+        for tp_n in (1, tp)
+    }
+    per_chip_ratio = round(
+        max(param_bytes[f"tp{tp}"].values())
+        / max(param_bytes["tp1"].values()),
+        4,
+    )
+    rooflines, collectives = {}, {}
+    for kind in configs:
+        for tp_n in (1, tp):
+            rl, coll = decode_program_verdict(engines[(kind, tp_n)])
+            rooflines[(kind, tp_n)] = rl
+            if tp_n == tp:
+                collectives[kind] = coll
+    roofline_ratio = {
+        kind: round(
+            rooflines[(kind, tp)]["roofline_s"]
+            / rooflines[(kind, 1)]["roofline_s"],
+            4,
+        )
+        for kind in configs
+    }
+    tp_all_reduces = {
+        kind: collectives[kind].get(comms.TP_ALL_REDUCE, {}).get("count", 0)
+        for kind in configs
+    }
+
+    gates = {
+        "bit_identical": all(bit_identical.values()),
+        "param_bytes_per_chip": per_chip_ratio <= 0.55,
+        "decode_roofline_latency": all(
+            r < 1.0 for r in roofline_ratio.values()
+        ),
+    }
+    full_run = args.steps_cap is None and not args.small
+    assert gates["bit_identical"], (
+        f"TP={tp} greedy streams diverged from TP=1: {bit_identical} — "
+        "the Megatron layout changed the sampled tokens"
+    )
+    if full_run:
+        assert gates["param_bytes_per_chip"], (
+            f"per-chip param bytes at TP={tp} are {per_chip_ratio:.2%} "
+            "of TP=1 (> 55%) — the table failed to shard the weights"
+        )
+        assert gates["decode_roofline_latency"], (
+            f"TP={tp} decode roofline did not beat TP=1 on every config "
+            f"(ratios {roofline_ratio}) — TP is paying HBM without "
+            "buying latency"
+        )
+        assert all(n >= 1 for n in tp_all_reduces.values()), (
+            f"TP decode compiled without a tensor all-reduce "
+            f"({tp_all_reduces}) — the weights replicated behind the "
+            "table's back"
+        )
+
+    def cfg_line(kind, tp_n):
+        rep, eng = reports[(kind, tp_n)], engines[(kind, tp_n)]
+        return {
+            **_serve_line(rep, eng, args, max_prompt=max_prompt,
+                          mesh=eng.mesh),
+            "decode_roofline": rooflines[(kind, tp_n)],
+        }
+
+    line = {
+        "metric": "lm_serve_tp_param_bytes_per_chip_ratio",
+        # max-over-chips resident param bytes, TP=N over TP=1
+        "value": per_chip_ratio,
+        "unit": "x",
+        "vs_baseline": None,
+        "bench_revision": BENCH_REVISION,
+        "tp": tp,
+        "layout_rules": _layout.layout_rules_provenance(),
+        "model": "synthetic LM, tied embedding head (4x embed gain — "
+                 "trained-model margin profile)",
+        "dims": dims,
+        "max_seq": max_seq,
+        "gates": gates,
+        "gates_enforced": bool(full_run),
+        "tp_param_bytes_per_chip_ratio": per_chip_ratio,
+        "param_bytes_per_chip": param_bytes,
+        "bit_identical": bit_identical,
+        # flat leaf keys so `ddlt obs history --gate` tracks them by name
+        "tp_decode_roofline_ms_dense_f32": round(
+            rooflines[("dense_f32", tp)]["roofline_s"] * 1e3, 6
+        ),
+        "tp_decode_roofline_ms_paged_int8": round(
+            rooflines[("paged_int8", tp)]["roofline_s"] * 1e3, 6
+        ),
+        "decode_roofline_ratio_vs_tp1": roofline_ratio,
+        "tp_all_reduces_per_decode": tp_all_reduces,
+        "collectives": collectives,
+        "configs": {
+            kind: {f"tp{tp_n}": cfg_line(kind, tp_n) for tp_n in (1, tp)}
+            for kind in configs
+        },
+        "platform": jax.default_backend(),
+        "virtual_pod": _is_virtual_pod(),
+    }
+    print(json.dumps(line))
+    report_path = args.report or artifact_name("TP")
     with open(report_path, "w") as f:
         json.dump(line, f, indent=2)
         f.write("\n")
@@ -3839,6 +4138,18 @@ def main() -> int:
         "teacher-forced logit MAE; emits the QUANT_r{NN}.json artifact",
     )
     parser.add_argument(
+        "--tp",
+        type=int,
+        default=None,
+        metavar="N",
+        help="tensor-parallel serving benchmark: TP=1 vs TP=N engines "
+        "(dense f32 + paged int8) at fixed model size on a virtual pod, "
+        "every placement resolved through the partition-rule table in "
+        "parallel/sharding.py; emits the TP_r{NN}.json artifact gated "
+        "on bit-identical greedy tokens, per-chip param HBM <= 0.55x "
+        "and a strictly-lower decode roofline",
+    )
+    parser.add_argument(
         "--spec",
         action="store_true",
         help="speculative-decoding benchmark (spec/): truncated-layer "
@@ -4220,6 +4531,14 @@ def main() -> int:
             "--obs-fleet needs --serve-replicas >= 2 (replica_death "
             "must leave a survivor for the failover chain to land on)"
         )
+    if args.tp is not None and args.tp < 2:
+        parser.error("--tp must be >= 2 (TP=1 is the built-in baseline)")
+    if args.tp and (args.serve or args.devices or args.data
+                    or args.faults or args.comms or args.quant
+                    or args.obs or args.obs_fleet or args.spec
+                    or args.serve_faults or args.ckpt_faults
+                    or args.goodput or args.attrib or args.overload):
+        parser.error("--tp is exclusive with the other benchmark modes")
     if args.spec and (args.serve or args.devices or args.data
                       or args.faults or args.comms or args.quant
                       or args.obs or args.serve_faults):
@@ -4405,6 +4724,8 @@ def main() -> int:
         return _run_ckpt_faults(args)
     if args.quant:
         return _run_quant(args)
+    if args.tp:
+        return _run_tp(args)
     if args.spec:
         return _run_spec(args)
     if args.obs:

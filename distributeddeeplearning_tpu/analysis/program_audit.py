@@ -547,6 +547,52 @@ def _source_line(obj) -> Tuple[str, int]:
         return "<unknown>", 0
 
 
+def check_rule_fallthrough(
+    tree_abs, *, prefix: str, name: str, path: str, line: int
+) -> List[Finding]:
+    """Every non-scalar leaf of ``tree_abs`` must match a rule in the
+    partition-rule layout table (``parallel/sharding.LAYOUT_RULES``) —
+    a fallthrough leaf silently replicates, which is the 'forgot to
+    shard the new leaf' class at the layout-engine layer (per-chip HBM
+    quietly loses its 1/TP factor; no crash, no wrong answer)."""
+    from distributeddeeplearning_tpu.parallel import sharding as layout
+
+    findings: List[Finding] = []
+    # rules read off the module at CALL time (not the def-time default):
+    # the audit must see the table as it currently stands
+    for leaf_name in layout.unmatched_leaves(
+        tree_abs, prefix=prefix, rules=layout.LAYOUT_RULES
+    ):
+        findings.append(
+            Finding(
+                "sharding-coverage", path, line,
+                f"{name}: leaf {leaf_name} matches NO rule in the "
+                "partition-rule layout table — it would silently "
+                "replicate on every chip",
+                hint="add a rule to parallel/sharding.LAYOUT_RULES "
+                "(scale/state leaves shard like the values they "
+                "describe; replicated-BY-DESIGN leaves still need an "
+                "explicit terminal rule so the intent is auditable)",
+            )
+        )
+    return findings
+
+
+def _layout_rules_line() -> Tuple[str, int]:
+    """file:line of the LAYOUT_RULES table itself — the fix site for
+    every rule-fallthrough finding."""
+    from distributeddeeplearning_tpu.parallel import sharding as layout
+
+    path = inspect.getsourcefile(layout) or "<unknown>"
+    try:
+        for i, text in enumerate(inspect.getsource(layout).splitlines(), 1):
+            if text.startswith("LAYOUT_RULES"):
+                return path, i
+    except OSError:
+        pass
+    return path, 0
+
+
 def check_sharding_coverage() -> List[Finding]:
     from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh
     from distributeddeeplearning_tpu.serve import kv_cache
@@ -590,6 +636,51 @@ def check_sharding_coverage() -> List[Finding]:
                     "leaf (params-shaped opt buffers included)",
                 )
             )
+
+    # rule-table fallthrough: every registered hot program's named
+    # operand trees — serve params on all three precisions (QTensor
+    # values AND scale leaves), drafter weights, both cache layouts x
+    # dtypes, and the engine/kernel operand namespaces — must resolve
+    # through the partition-rule layout table with no silent
+    # replicate-fallthrough leaf.  Findings point at the table itself:
+    # the fix is a new rule, not a call-site patch.
+    from distributeddeeplearning_tpu.spec.decode import SpeculativeDecoder
+
+    fx = _serve_fixture()
+    spec_dec = SpeculativeDecoder(
+        fx.dense_f32, drafter="truncated", draft_tokens=2, draft_layers=1
+    )
+    rpath, rline = _layout_rules_line()
+    io_abs = {
+        "tokens": _sds((_SLOTS,), jnp.int32),
+        "slots": _sds((_SLOTS,), jnp.int32),
+        "pos": _sds((_SLOTS,), jnp.int32),
+        "block_tables": _sds((_SLOTS, 4), jnp.int32),
+    }
+    attn_abs = {
+        "q": _sds((_SLOTS, 1, _H, _D // _H), jnp.float32),
+        "out": _sds((_SLOTS, 1, _H, _D // _H), jnp.float32),
+        "k_pages": _sds((5, _PAGE, _H, _D // _H), jnp.float32),
+        "v_pages": _sds((5, _PAGE, _H, _D // _H), jnp.float32),
+        "k_scale": _sds((5, _PAGE, _H), jnp.float32),
+        "v_scale": _sds((5, _PAGE, _H), jnp.float32),
+        "tables": _sds((_SLOTS, 4), jnp.int32),
+        "posmat": _sds((_SLOTS, 4), jnp.int32),
+    }
+    for tname, tree, prefix in (
+        ("serve.params.f32", fx.params, "params"),
+        ("serve.params.w_int8", fx.qparams, "params"),
+        ("spec.drafter.params", spec_dec.drafter._dparams, "params"),
+        ("kv.dense.f32", fx.dense_f32.cache, "kv_dense"),
+        ("kv.dense.int8", fx.dense_int8.cache, "kv_dense"),
+        ("kv.paged.f32", fx.paged_f32.cache, "kv_paged"),
+        ("kv.paged.int8", fx.paged_int8.cache, "kv_paged"),
+        ("engine.io", io_abs, "io"),
+        ("flash_decode.operands", attn_abs, "attn"),
+    ):
+        findings += check_rule_fallthrough(
+            tree, prefix=prefix, name=tname, path=rpath, line=rline
+        )
     return findings
 
 
@@ -870,7 +961,10 @@ def audit_train_step() -> List[Finding]:
     if data_parallel_size(fx.mesh) > 1:
         path, line = rec.location()
         compiled = implicit.lower(_absify(fx.state), fx.batch_abs).compile()
-        stats = comms.collective_stats(compiled.as_text())
+        # mesh-aware: TP all-reduces (tensor-axis replica groups) classify
+        # separately, so the gradient-sync check can't be satisfied by —
+        # or false-positive on — tensor-parallel traffic
+        stats = comms.collective_stats(compiled.as_text(), mesh=fx.mesh)
         if stats.get("all-reduce", {}).get("count", 0) < 1:
             findings.append(
                 Finding(

@@ -264,7 +264,7 @@ def forward_prefill(
 
 
 def _block_decode(p, x, k_l, v_l, pos, *, num_heads: int, k_s=None, v_s=None,
-                  kernel: str = "gather"):
+                  kernel: str = "gather", mesh=None):
     """One block's single-token decode against its cache layer.
 
     ``x``: [B, d] residual stream for the current token of every slot;
@@ -308,7 +308,7 @@ def _block_decode(p, x, k_l, v_l, pos, *, num_heads: int, k_s=None, v_s=None,
         k_l = k_l.at[rows, pos].set(k_t.astype(k_l.dtype))
         v_l = v_l.at[rows, pos].set(v_t.astype(v_l.dtype))
     ctx = _fd.decode_attention_dense(
-        q, k_l, v_l, k_s, v_s, k_t, v_t, pos, kernel=kernel
+        q, k_l, v_l, k_s, v_s, k_t, v_t, pos, kernel=kernel, mesh=mesh
     ).reshape(b, d).astype(x.dtype)
     x = x + _mm(ctx, p["proj"])
 
@@ -318,7 +318,7 @@ def _block_decode(p, x, k_l, v_l, pos, *, num_heads: int, k_s=None, v_s=None,
 
 
 def forward_decode(params, token, cache, pos, *, num_heads: int,
-                   kernel: str = "gather"):
+                   kernel: str = "gather", mesh=None):
     """Single-token decode step: next-token logits from the KV cache.
 
     ``token``: [B] int32 — each slot's current token; ``pos``: [B] int32 —
@@ -350,7 +350,7 @@ def forward_decode(params, token, cache, pos, *, num_heads: int,
         p, k_l, v_l, k_s, v_s = xs
         carry, k_l, v_l, k_s, v_s = _block_decode(
             p, carry, k_l, v_l, pos, num_heads=num_heads, k_s=k_s, v_s=v_s,
-            kernel=kernel,
+            kernel=kernel, mesh=mesh,
         )
         return carry, (k_l, v_l, k_s, v_s)
 
@@ -374,7 +374,7 @@ def forward_decode(params, token, cache, pos, *, num_heads: int,
 
 def _block_decode_paged(
     p, x, k_l, v_l, pos, block_tables, *, num_heads: int, page_size: int,
-    k_s=None, v_s=None, kernel: str = "gather",
+    k_s=None, v_s=None, kernel: str = "gather", mesh=None,
 ):
     """One block's single-token decode against a PAGED cache layer.
 
@@ -419,7 +419,7 @@ def _block_decode_paged(
         v_l = v_l.at[page, off].set(v_t.astype(v_l.dtype))
     ctx = _fd.decode_attention_paged(
         q, k_l, v_l, k_s, v_s, k_t, v_t, pos, block_tables,
-        page_size=page_size, kernel=kernel,
+        page_size=page_size, kernel=kernel, mesh=mesh,
     ).reshape(b, d).astype(x.dtype)
     x = x + _mm(ctx, p["proj"])
 
@@ -430,7 +430,7 @@ def _block_decode_paged(
 
 def forward_decode_paged(
     params, token, cache, pos, block_tables, *, num_heads: int,
-    page_size: int, kernel: str = "gather",
+    page_size: int, kernel: str = "gather", mesh=None,
 ):
     """Single-token decode step over the PAGED cache layout.
 
@@ -458,7 +458,7 @@ def forward_decode_paged(
         carry, k_l, v_l, k_s, v_s = _block_decode_paged(
             p, carry, k_l, v_l, pos, block_tables,
             num_heads=num_heads, page_size=page_size, k_s=k_s, v_s=v_s,
-            kernel=kernel,
+            kernel=kernel, mesh=mesh,
         )
         return carry, (k_l, v_l, k_s, v_s)
 
@@ -482,7 +482,7 @@ def forward_decode_paged(
 
 def forward_prefill_chunk(
     params, tokens, cache, block_table, offset, *, num_heads: int,
-    page_size: int, kernel: str = "gather",
+    page_size: int, kernel: str = "gather", mesh=None,
 ):
     """One CHUNK of a prompt prefilled against the paged cache.
 
@@ -554,7 +554,7 @@ def forward_prefill_chunk(
         # the chunk boundaries fell.  Both kernels preserve this.
         ctx = _fd.chunk_attention(
             q, k_l, v_l, k_s, v_s, block_table, posns,
-            page_size=page_size, kernel=kernel,
+            page_size=page_size, kernel=kernel, mesh=mesh,
         ).reshape(C, d).astype(carry.dtype)
         out = carry + _mm(ctx, p["proj"])
         h = _layer_norm(out, p["ln2"])
@@ -583,7 +583,7 @@ def forward_prefill_chunk(
 
 def forward_verify(
     params, tokens, cache, pos, draft_len, *, num_heads: int,
-    kernel: str = "gather",
+    kernel: str = "gather", mesh=None,
 ):
     """Batched K+1-token verification step against the DENSE cache — the
     verifier half of speculative decoding (``spec/``).
@@ -649,7 +649,7 @@ def forward_verify(
         k_l = k_l.at[rows, wpos].set(k_c.astype(k_l.dtype), mode="drop")
         v_l = v_l.at[rows, wpos].set(v_c.astype(v_l.dtype), mode="drop")
         ctx = _fd.verify_attention_dense(
-            q, k_l, v_l, posmat, kernel=kernel
+            q, k_l, v_l, posmat, kernel=kernel, mesh=mesh
         ).reshape(b, K1, d).astype(carry.dtype)
         out = carry + _mm(ctx, p["proj"])
         h = _layer_norm(out, p["ln2"])
@@ -673,7 +673,7 @@ def forward_verify(
 
 def forward_verify_paged(
     params, tokens, cache, pos, draft_len, block_tables, *,
-    num_heads: int, page_size: int, kernel: str = "gather",
+    num_heads: int, page_size: int, kernel: str = "gather", mesh=None,
 ):
     """Batched K+1-token verification step over the PAGED cache layout.
 
@@ -728,7 +728,7 @@ def forward_verify_paged(
         v_l = v_l.at[pages, offs].set(v_c.astype(v_l.dtype))
         ctx = _fd.verify_attention_paged(
             q, k_l, v_l, block_tables, posmat,
-            page_size=page_size, kernel=kernel,
+            page_size=page_size, kernel=kernel, mesh=mesh,
         ).reshape(b, K1, d).astype(carry.dtype)
         out = carry + _mm(ctx, p["proj"])
         h = _layer_norm(out, p["ln2"])

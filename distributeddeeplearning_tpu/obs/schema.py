@@ -65,6 +65,7 @@ __all__ = [
     "validate_goodput_payload",
     "validate_attrib_payload",
     "validate_overload_payload",
+    "validate_tp_payload",
 ]
 
 #: latency blocks whose percentile keys are a cross-artifact contract
@@ -896,6 +897,104 @@ def validate_overload_payload(payload: Dict[str, Any]) -> None:
         raise SchemaError("; ".join(errors))
 
 
+def validate_tp_payload(payload: Dict[str, Any]) -> None:
+    """Strict schema for the ``TP_r{NN}.json`` artifact body.
+
+    Tensor-parallel serving's evidence trail: the artifact must carry
+    the TP degree (>= 2 — a TP artifact at TP=1 measured nothing), the
+    layout-rule provenance string that resolved every sharding in the
+    run, all three gate booleans (bit-identical greedy tokens,
+    per-chip param HBM ~ 1/TP, decode roofline strictly below TP=1),
+    the ledger-attributed per-chip byte ratio, and per-config roofline
+    latencies — the leaves ``ddlt obs history --gate`` tracks across
+    revisions.
+    """
+    errors: List[str] = []
+
+    def require(cond: bool, msg: str) -> None:
+        if not cond:
+            errors.append(msg)
+
+    for key in ("metric", "value", "unit", "bench_revision", "platform",
+                "virtual_pod", "tp", "layout_rules", "dims", "configs",
+                "param_bytes_per_chip", "bit_identical", "gates"):
+        require(key in payload, f"missing top-level key {key!r}")
+
+    tp = payload.get("tp")
+    require(
+        isinstance(tp, int) and tp >= 2,
+        "tp must be an int >= 2 (a TP artifact at TP=1 measured nothing)",
+    )
+    require(
+        isinstance(payload.get("layout_rules"), str)
+        and bool(payload.get("layout_rules")),
+        "layout_rules must be the non-empty rule-table provenance string",
+    )
+    require(
+        isinstance(payload.get("tp_param_bytes_per_chip_ratio"),
+                   (int, float)),
+        "tp_param_bytes_per_chip_ratio must be numeric (the "
+        "ledger-attributed per-chip HBM ratio IS the memory evidence)",
+    )
+    for key in ("tp_decode_roofline_ms_dense_f32",
+                "tp_decode_roofline_ms_paged_int8"):
+        require(
+            isinstance(payload.get(key), (int, float)),
+            f"{key} must be numeric (the tracked decode-latency leaf)",
+        )
+
+    bit = payload.get("bit_identical")
+    if isinstance(bit, dict) and bit:
+        for name, verdict in bit.items():
+            require(
+                isinstance(verdict, bool),
+                f"bit_identical[{name!r}] must be a bool",
+            )
+    else:
+        require(False, "bit_identical must be a non-empty dict of "
+                       "per-config verdicts")
+
+    configs = payload.get("configs")
+    if isinstance(configs, dict) and configs:
+        for name, cfg in configs.items():
+            if not isinstance(cfg, dict):
+                require(False, f"configs[{name!r}] must be a dict")
+                continue
+            for variant, line in cfg.items():
+                if not isinstance(line, dict):
+                    require(
+                        False,
+                        f"configs[{name!r}][{variant!r}] must be a dict",
+                    )
+                    continue
+                require(
+                    isinstance(line.get("tp"), int),
+                    f"configs[{name!r}][{variant!r}].tp must be an int "
+                    "(every serve line carries its TP provenance)",
+                )
+                require(
+                    isinstance(line.get("layout_rules"), str),
+                    f"configs[{name!r}][{variant!r}].layout_rules must "
+                    "be the rule-table provenance string",
+                )
+    else:
+        require(False, "configs must be a non-empty dict")
+
+    gates = payload.get("gates")
+    if isinstance(gates, dict):
+        for gk in ("bit_identical", "param_bytes_per_chip",
+                   "decode_roofline_latency"):
+            require(
+                isinstance(gates.get(gk), bool),
+                f"gates.{gk} must be a bool",
+            )
+    else:
+        require(False, "gates must be a dict")
+
+    if errors:
+        raise SchemaError("; ".join(errors))
+
+
 #: Ordered most-specific-first: the FIRST matching prefix wins, so a
 #: name matching two prefixes (``OBS_FLEET_*`` also matches ``OBS_*``)
 #: binds to its specific schema, and every specific kind — ``GOODPUT_*``
@@ -910,6 +1009,7 @@ _PREFIX_VALIDATORS = (
     ("GOODPUT_", validate_goodput_payload),
     ("ATTRIB_", validate_attrib_payload),
     ("OVERLOAD_", validate_overload_payload),
+    ("TP_", validate_tp_payload),
 )
 
 

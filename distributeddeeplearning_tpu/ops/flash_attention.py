@@ -545,13 +545,10 @@ def make_flash_attention(block_q: Optional[int] = None,
         if mesh is None or mesh.devices.size == 1:
             return _local(q, k, v, mask, dtype)
 
-        from jax.sharding import PartitionSpec as P
-
-        from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
-
+        from distributeddeeplearning_tpu.parallel import sharding as _layout
         from distributeddeeplearning_tpu.parallel.compat import shard_map
 
-        qkv_spec = P(DATA_AXES, None, "tensor", None)
+        qkv_spec, mask_spec = _layout.tp_attention_specs()
         if mask is None:
             # keep mask=None through the shard_map so the kernels compile
             # with has_bias=False — fabricating an all-ones mask here would
@@ -564,7 +561,6 @@ def make_flash_attention(block_q: Optional[int] = None,
                 out_specs=qkv_spec,
             )(q, k, v)
         mask = jnp.broadcast_to(mask, (q.shape[0], 1, 1, q.shape[1]))
-        mask_spec = P(DATA_AXES, None, None, None)
         return shard_map(
             lambda q, k, v, m: _local(q, k, v, m, dtype),
             mesh=mesh,

@@ -61,6 +61,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from distributeddeeplearning_tpu.parallel import sharding as _layout
+
 try:  # TPU-specific pallas extras are absent on CPU-only installs
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -285,6 +287,66 @@ def _pallas_attention(
     )
 
 
+def attention_partition_specs(operands, *, mesh):
+    """PartitionSpecs for the Pallas kernel's operands under a tensor-
+    parallel mesh, resolved through the partition-rule layout table (the
+    ``attn/`` rules in ``parallel.sharding.LAYOUT_RULES``) — the kernel's
+    block-spec partitioning never hand-wires a mesh axis.  ``operands``:
+    name → array (None entries are absent kernel slots and are skipped).
+    Returns ``(names, in_specs, out_spec)``; size-1 dummy operands
+    replicate via the table's divisibility drop."""
+    names = [k for k, v in operands.items() if v is not None]
+    in_specs = tuple(
+        _layout.spec_for(
+            f"attn/{k}", shape=tuple(operands[k].shape), mesh=mesh
+        )
+        for k in names
+    )
+    out_spec = _layout.spec_for(
+        "attn/out", shape=tuple(operands["q"].shape), mesh=mesh
+    )
+    return names, in_specs, out_spec
+
+
+def _pallas_tp(mesh, q4, k_l, v_l, k_s, v_s, tables, posmat, *, block,
+               k_own=None, v_own=None):
+    """Dispatch the Pallas kernel, shard_mapped over the ``tensor`` mesh
+    axis when one is active: each chip runs the kernel over its LOCAL
+    heads (the grid's head axis shrinks to h/tp; heads are independent in
+    attention, so no collective is needed), with operand partitioning
+    resolved through the same layout table the engines use — paged int8
+    decode works under TP without a second sharding scheme."""
+    if _layout.tensor_parallel_size(mesh) <= 1:
+        return _pallas_attention(
+            q4, k_l, v_l, k_s, v_s, tables, posmat, block=block,
+            k_own=k_own, v_own=v_own,
+        )
+    from distributeddeeplearning_tpu.parallel.compat import shard_map
+
+    operands = {
+        "q": q4, "k_pages": k_l, "v_pages": v_l,
+        "k_scale": k_s, "v_scale": v_s,
+        "tables": tables, "posmat": posmat,
+        "k_own": k_own, "v_own": v_own,
+    }
+    names, in_specs, out_spec = attention_partition_specs(
+        operands, mesh=mesh
+    )
+
+    def run(*present):
+        vals = dict(zip(names, present))
+        return _pallas_attention(
+            vals["q"], vals["k_pages"], vals["v_pages"],
+            vals.get("k_scale"), vals.get("v_scale"),
+            vals["tables"], vals["posmat"], block=block,
+            k_own=vals.get("k_own"), v_own=vals.get("v_own"),
+        )
+
+    return shard_map(
+        run, mesh=mesh, in_specs=in_specs, out_specs=out_spec,
+    )(*(operands[k] for k in names))
+
+
 def _dense_block(s: int, cap: int = 128) -> int:
     """Largest power-of-two-descending divisor of ``s`` up to ``cap`` —
     the synthetic "page size" the dense layout tiles its [B, S] rows into
@@ -358,7 +420,7 @@ def _xla_int8_decode(q3, kf, vf, k_sc_t, v_sc_t, k_t, v_t, pos, s, hd):
 
 def decode_attention_paged(
     q3, k_l, v_l, k_s, v_s, k_t, v_t, pos, block_tables, *,
-    page_size: int, kernel: str = "gather",
+    page_size: int, kernel: str = "gather", mesh=None,
 ):
     """Single-token decode attention over the paged pool.
 
@@ -374,8 +436,8 @@ def decode_attention_paged(
     if kernel in ("flash", "pallas", "xla"):
         impl = _flash_impl(kernel)
         if impl == "pallas" and page_size >= PALLAS_BLOCK_FLOOR:
-            out = _pallas_attention(
-                q3[:, None], k_l, v_l, k_s, v_s, block_tables,
+            out = _pallas_tp(
+                mesh, q3[:, None], k_l, v_l, k_s, v_s, block_tables,
                 pos[:, None], block=page_size,
                 k_own=k_t if k_s is not None else None,
                 v_own=v_t if k_s is not None else None,
@@ -445,7 +507,8 @@ def _gather_decode_paged(
 
 
 def decode_attention_dense(
-    q3, k_l, v_l, k_s, v_s, k_t, v_t, pos, *, kernel: str = "gather"
+    q3, k_l, v_l, k_s, v_s, k_t, v_t, pos, *, kernel: str = "gather",
+    mesh=None,
 ):
     """Single-token decode attention over the dense [b, S, h, hd] layout
     (same contract as :func:`decode_attention_paged`, no indirection)."""
@@ -459,8 +522,8 @@ def decode_attention_dense(
             kp, vp, ksp, vsp, tables = _dense_as_pages(
                 k_l, v_l, k_s, v_s, block
             )
-            out = _pallas_attention(
-                q3[:, None], kp, vp, ksp, vsp, tables, pos[:, None],
+            out = _pallas_tp(
+                mesh, q3[:, None], kp, vp, ksp, vsp, tables, pos[:, None],
                 block=block,
                 k_own=k_t if k_s is not None else None,
                 v_own=v_t if k_s is not None else None,
@@ -501,7 +564,7 @@ def _gather_decode_dense(q3, k_l, v_l, k_s, v_s, k_t, v_t, pos):
 
 def chunk_attention(
     q_c, k_l, v_l, k_s, v_s, block_table, posns, *,
-    page_size: int, kernel: str = "gather",
+    page_size: int, kernel: str = "gather", mesh=None,
 ):
     """Chunked-prefill history attention: ``q_c`` [C, h, hd] at logical
     positions ``posns`` [C] against ONE sequence's pages (``block_table``
@@ -516,8 +579,8 @@ def chunk_attention(
     if kernel in ("flash", "pallas", "xla"):
         impl = _flash_impl(kernel)
         if impl == "pallas" and page_size >= PALLAS_BLOCK_FLOOR:
-            out = _pallas_attention(
-                q_c[None], k_l, v_l, k_s, v_s, block_table[None],
+            out = _pallas_tp(
+                mesh, q_c[None], k_l, v_l, k_s, v_s, block_table[None],
                 posns[None], block=page_size,
             )
             return out[0]
@@ -569,7 +632,7 @@ def _gather_chunk(
 
 def verify_attention_paged(
     q4, k_l, v_l, block_tables, posmat, *, page_size: int,
-    kernel: str = "gather",
+    kernel: str = "gather", mesh=None,
 ):
     """Speculative-verify attention over the paged pool: ``q4``
     [b, K1, h, hd] with per-query positions ``posmat`` [b, K1].  f32
@@ -584,8 +647,8 @@ def verify_attention_paged(
             _flash_impl(kernel) == "pallas"
             and page_size >= PALLAS_BLOCK_FLOOR
         ):
-            return _pallas_attention(
-                q4, k_l, v_l, None, None, block_tables, posmat,
+            return _pallas_tp(
+                mesh, q4, k_l, v_l, None, None, block_tables, posmat,
                 block=page_size,
             )
     nb = block_tables.shape[1]
@@ -595,7 +658,8 @@ def verify_attention_paged(
     return _verify_dense_math(q4, k_seq, v_seq, posmat, hd)
 
 
-def verify_attention_dense(q4, k_l, v_l, posmat, *, kernel: str = "gather"):
+def verify_attention_dense(q4, k_l, v_l, posmat, *, kernel: str = "gather",
+                           mesh=None):
     """Speculative-verify attention over the dense cache ``k_l``/``v_l``
     [b, S, h, hd] (f32 only, see :func:`verify_attention_paged`)."""
     b, K1, num_heads, hd = q4.shape
@@ -607,8 +671,8 @@ def verify_attention_dense(q4, k_l, v_l, posmat, *, kernel: str = "gather"):
             kp, vp, _, _, tables = _dense_as_pages(
                 k_l, v_l, None, None, block
             )
-            return _pallas_attention(
-                q4, kp, vp, None, None, tables, posmat, block=block
+            return _pallas_tp(
+                mesh, q4, kp, vp, None, None, tables, posmat, block=block
             )
     return _verify_dense_math(q4, k_l, v_l, posmat, hd)
 

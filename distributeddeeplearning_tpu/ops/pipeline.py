@@ -34,8 +34,9 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
+from distributeddeeplearning_tpu.parallel import sharding as _layout
 from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
 
 PyTree = Any
@@ -110,7 +111,7 @@ def pipeline_apply(
     m = num_microbatches
     if param_partition is None:
         param_spec = jax.tree_util.tree_map(
-            lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))),
+            lambda leaf: _layout.leading_axis_spec(axis_name, leaf.ndim),
             stage_params,
         )
     else:
@@ -122,7 +123,7 @@ def pipeline_apply(
                     f"shape {leaf.shape} minus the stage dim"
                 )
             dims = dims + (None,) * (leaf.ndim - 1 - len(dims))
-            return P(axis_name, *dims)
+            return _layout.staged_param_spec(axis_name, dims)
 
         p_leaves, treedef = jax.tree_util.tree_flatten(stage_params)
         # flatten_up_to (not tree_map): partition leaves may be None, which
@@ -132,7 +133,7 @@ def pipeline_apply(
             treedef,
             [_leaf_spec(a, p) for a, p in zip(p_leaves, part_leaves)],
         )
-    x_spec = P(DATA_AXES, *([None] * (x.ndim - 1)))
+    x_spec = _layout.batch_spec(x.ndim)
 
     tick_stage_fn = jax.checkpoint(stage_fn) if remat else stage_fn
 

@@ -35,9 +35,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
-from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
+from distributeddeeplearning_tpu.parallel import sharding as _layout
 
 _NEG_BIG = -1e30  # finite mask fill; -inf poisons the online-softmax max
 
@@ -420,8 +420,7 @@ def ring_attention(
     if mask is None:
         mask = jnp.ones((q.shape[0], 1, 1, q.shape[1]), bool)
 
-    qkv_spec = P(DATA_AXES, axis_name, None, None)
-    mask_spec = P(DATA_AXES, None, None, axis_name)
+    qkv_spec, mask_spec = _layout.seq_parallel_specs(axis_name)
     body = partial(
         _ring_body,
         axis_name=axis_name,

@@ -29,9 +29,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
-from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
+from distributeddeeplearning_tpu.parallel import sharding as _layout
 
 
 def _ulysses_body(q, k, v, mask, *, axis_name: str, n: int, dtype,
@@ -142,8 +142,7 @@ def ulysses_attention(
     else:
         mask = jnp.broadcast_to(mask, (q.shape[0], 1, 1, q.shape[1]))
 
-    qkv_spec = P(DATA_AXES, axis_name, None, None)
-    mask_spec = P(DATA_AXES, None, None, axis_name)
+    qkv_spec, mask_spec = _layout.seq_parallel_specs(axis_name)
     body = partial(
         _ulysses_body, axis_name=axis_name, n=n, dtype=dtype, causal=causal,
         use_flash=use_flash, block_q=block_q, block_k=block_k,

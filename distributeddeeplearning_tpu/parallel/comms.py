@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
 
@@ -255,7 +255,8 @@ def comm_opt_tree(
     opt_state: PyTree, params_treedef, layout: BucketLayout
 ) -> PyTree:
     """Params-shaped optimizer buffers -> tuples of per-bucket flat vectors
-    (global length; shard physically with a ``P(DATA_AXES)`` sharding)."""
+    (global length; shard physically via the ``comm/`` layout rules in
+    ``parallel/sharding.py``)."""
     return map_params_subtrees(
         opt_state, params_treedef, layout.to_buckets, lambda leaf: leaf
     )
@@ -291,7 +292,11 @@ def prepare_comm_state(
         and set(opt) == {"base", "residual"}
     ):
         return state  # already prepared (e.g. a restore template reused)
-    shard = NamedSharding(mesh, P(DATA_AXES))
+    from distributeddeeplearning_tpu.parallel import sharding as _layout
+
+    shard = _layout.resolve_shardings(
+        mesh, {"bucket": None}, prefix="comm"
+    )["bucket"]
     p_treedef = jax.tree_util.tree_structure(state.params)
     if weight_update_sharding:
         base = map_params_subtrees(
@@ -362,8 +367,55 @@ COLLECTIVE_OPS = (
     "all-to-all",
 )
 
+# Tensor-parallel all-reduces (the per-block activation reduction Megatron
+# sharding issues on the serve path) reported under their own key so the
+# comm-path lint's gradient-signature check never counts them.
+TP_ALL_REDUCE = "tp-all-reduce"
 
-def collective_stats(hlo_text: str):
+
+def _tensor_axis_groups(mesh) -> Optional[frozenset]:
+    """Partition-id groups of ``mesh``'s ``tensor`` axis (None if the axis
+    is absent or trivial).  Partition ids follow the mesh's flattened
+    device order — the assignment ``jax.jit`` derives from the mesh."""
+    names = list(mesh.axis_names)
+    if "tensor" not in names:
+        return None
+    axis = names.index("tensor")
+    size = mesh.devices.shape[axis]
+    if size <= 1:
+        return None
+    ids = np.arange(mesh.devices.size).reshape(mesh.devices.shape)
+    rows = np.moveaxis(ids, axis, -1).reshape(-1, size)
+    return frozenset(frozenset(int(i) for i in row) for row in rows)
+
+
+def _replica_groups(line: str) -> Optional[list]:
+    """Replica groups from an HLO collective line, as frozensets of
+    partition ids.  Handles the literal ``{{0,1},{2,3}}`` form and the
+    iota ``[G,S]<=[dims](T(perm))?`` form; None when absent."""
+    import re
+
+    m = re.search(r"replica_groups=\{((?:\{[\d,]*\},?)+)\}", line)
+    if m:
+        return [
+            frozenset(int(x) for x in grp.split(",") if x)
+            for grp in re.findall(r"\{([\d,]*)\}", m.group(1))
+        ]
+    m = re.search(
+        r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?",
+        line,
+    )
+    if m:
+        g, s = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        arr = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            arr = arr.transpose([int(x) for x in m.group(4).split(",")])
+        return [frozenset(int(i) for i in row) for row in arr.reshape(g, s)]
+    return None
+
+
+def collective_stats(hlo_text: str, *, mesh=None):
     """{op: {count, bytes}} from optimized HLO — WHICH collectives the
     compiled program issues per step and how many bytes each moves
     (output-shape bytes).
@@ -375,8 +427,16 @@ def collective_stats(hlo_text: str):
     for equal-size collectives and under-reports all-gather-start /
     reduce-scatter-start by the axis-size factor (their operand and result
     differ by exactly that factor).
+
+    With ``mesh``, all-reduces whose replica groups run exactly over the
+    mesh's ``tensor`` axis are reported under ``"tp-all-reduce"`` instead
+    of ``"all-reduce"`` — tensor-parallel activation reductions are a
+    different budget from gradient reductions, and the comm-path lint's
+    gradient-signature check must not count them.
     """
     import re
+
+    tensor_groups = _tensor_axis_groups(mesh) if mesh is not None else None
 
     bpe = {"f32": 4, "bf16": 2, "s32": 4, "u32": 4, "f16": 2, "u8": 1,
            "s8": 1, "pred": 1, "f64": 8, "s64": 8, "u64": 8}
@@ -395,6 +455,7 @@ def collective_stats(hlo_text: str):
         return out
 
     stats = {op: {"count": 0, "bytes": 0} for op in COLLECTIVE_OPS}
+    stats[TP_ALL_REDUCE] = {"count": 0, "bytes": 0}
     for line in hlo_text.splitlines():
         line = line.strip()
         m = re.match(r"%?\S+ = (\([^)]*\)|\S+) ([\w-]+)\(", line)
@@ -402,8 +463,12 @@ def collective_stats(hlo_text: str):
             continue
         op = m.group(2)
         base = op[:-len("-start")] if op.endswith("-start") else op
-        if base not in stats or op.endswith("-done"):
+        if base not in COLLECTIVE_OPS or op.endswith("-done"):
             continue
+        if base == "all-reduce" and tensor_groups is not None:
+            groups = _replica_groups(line)
+            if groups and all(g in tensor_groups for g in groups):
+                base = TP_ALL_REDUCE
         shapes = shape_bytes_list(m.group(1))
         if op.endswith("-start") and m.group(1).startswith("("):
             # (operands…, results…[, context scalars]): the result half is

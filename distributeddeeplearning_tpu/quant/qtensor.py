@@ -75,13 +75,25 @@ def _flatten(qt: QTensor):
     return (qt.values, qt.scales), (qt.axis, qt.block)
 
 
+def _flatten_with_keys(qt: QTensor):
+    # Named child keys (".values" / ".scales") so path-walking consumers —
+    # the partition-rule layout engine in ``parallel.sharding`` resolves
+    # leaves by name — see readable paths instead of flat indices.
+    return (
+        (jax.tree_util.GetAttrKey("values"), qt.values),
+        (jax.tree_util.GetAttrKey("scales"), qt.scales),
+    ), (qt.axis, qt.block)
+
+
 def _unflatten(aux, children) -> QTensor:
     values, scales = children
     axis, block = aux
     return QTensor(values, scales, axis, block)
 
 
-jax.tree_util.register_pytree_node(QTensor, _flatten, _unflatten)
+jax.tree_util.register_pytree_with_keys(
+    QTensor, _flatten_with_keys, _unflatten, flatten_func=_flatten
+)
 
 
 def _amax(x: jax.Array, axis: int, observer=None) -> jax.Array:

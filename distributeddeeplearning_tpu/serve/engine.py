@@ -21,10 +21,15 @@ Sampling follows ``train/step.py``'s RNG convention: one base key, the
 step counter folded in per call (``jax.random.fold_in``), so a serve run
 is exactly reproducible from (seed, request order) alone.
 
-With a ``mesh`` the cache shards slots over the data axes and heads over
-``tensor`` (``kv_cache.cache_sharding``); params replicate.  Decode then
-runs each slot's attention on the chip that owns it — the data-parallel
-serving layout.
+With a ``mesh``, every device placement resolves through the partition-
+rule layout table (``parallel.sharding.LAYOUT_RULES``): the cache shards
+slots over the data axes and heads over ``tensor``
+(``kv_cache.cache_sharding``), and params shard Megatron-style over the
+``tensor`` axis — column-parallel qkv/w_in, row-parallel proj/w_out,
+vocab-parallel embed/head — so a ``data=1 × tensor=N`` mesh serves a
+model N× wider than one chip's HBM (``tensor_parallel_engine``).  A pure-
+data mesh degenerates to the old layout (every ``tensor`` rule maps onto
+an axis of size 1, i.e. replication); no spec is hand-wired here.
 """
 
 from __future__ import annotations
@@ -46,6 +51,8 @@ from distributeddeeplearning_tpu.models.pipelined_transformer import (
     forward_prefill_chunk,
 )
 from distributeddeeplearning_tpu.ops.flash_decode import resolve_kernel
+from distributeddeeplearning_tpu.parallel import sharding as layout
+from distributeddeeplearning_tpu.parallel.mesh import data_parallel_size
 from distributeddeeplearning_tpu.quant.calibrate import params_dtype
 from distributeddeeplearning_tpu.serve.kv_cache import (
     OutOfPages,
@@ -242,6 +249,43 @@ def data_parallel_engine(params, *, num_heads: int, batch_slots: int,
     return engine, mesh
 
 
+def tensor_parallel_engine(params, *, tp: int, num_heads: int,
+                           batch_slots: int, max_seq: int,
+                           kv_layout: str = "dense", **engine_kw):
+    """Engine with weights tensor-parallel over the first ``tp`` devices.
+
+    Builds a ``data=1 × tensor=tp`` mesh and hands it to the requested
+    engine layout; every placement resolves through the partition-rule
+    table, so qkv/w_in shard column-parallel, proj/w_out row-parallel,
+    embed/head vocab-parallel, and the KV cache's head dim splits too —
+    per-chip param HBM ≈ 1/tp.  ``tp=1`` returns the plain single-device
+    engine (the bench baseline).  Returns ``(engine, mesh)``; ``mesh`` is
+    None for ``tp=1``.
+    """
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    mesh = None
+    if tp > 1:
+        from distributeddeeplearning_tpu.parallel import MeshSpec, create_mesh
+
+        devs = jax.devices()
+        if tp > len(devs):
+            raise ValueError(
+                f"tp={tp} exceeds the {len(devs)} visible devices"
+            )
+        mesh = create_mesh(
+            MeshSpec(data=1, tensor=tp), devices=devs[:tp]
+        )
+    cls = (
+        PagedInferenceEngine if kv_layout == "paged" else InferenceEngine
+    )
+    engine = cls(
+        params, num_heads=num_heads, batch_slots=batch_slots,
+        max_seq=max_seq, mesh=mesh, **engine_kw,
+    )
+    return engine, mesh
+
+
 class InferenceEngine:
     """KV-cached generation over a ``pipelined_transformer`` param pytree.
 
@@ -327,34 +371,48 @@ class InferenceEngine:
         )
 
         sharded = mesh is not None and mesh.devices.size > 1
+        self.tp = layout.tensor_parallel_size(mesh) if sharded else 1
+        self.layout_rules = layout.layout_rules_provenance()
         self._params_sharding = None  # reload re-places onto the same layout
         if sharded:
-            if batch_slots % int(np.prod(
-                [mesh.shape[a] for a in ("data", "fsdp")]
-            )):
+            if batch_slots % data_parallel_size(mesh):
                 raise ValueError(
                     f"batch_slots {batch_slots} not divisible by the mesh's "
                     f"data axes {dict(mesh.shape)}"
                 )
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
-
+            if num_heads % self.tp:
+                raise ValueError(
+                    f"num_heads {num_heads} not divisible by the mesh's "
+                    f"tensor axis ({self.tp}) — TP shards attention heads"
+                )
+            # every placement below comes out of the partition-rule layout
+            # table; nothing here names a mesh axis directly
             c_shard = cache_sharding(mesh, quantized=self.kv_dtype == "int8")
-            rep = NamedSharding(mesh, P())
-            slot_vec = NamedSharding(mesh, P(DATA_AXES))
-            p_shard = jax.tree_util.tree_map(lambda _: rep, params)
+            rep = layout.replicated(mesh)
+            slot_vec = layout.io_sharding(mesh, "tokens", shape=(batch_slots,))
+            scalar = layout.io_sharding(mesh, "step", shape=())
+            p_shard = layout.resolve_shardings(mesh, params, prefix="params")
             self._params_sharding = p_shard
             self.params = jax.device_put(params, p_shard)
             self._cache = jax.device_put(self._cache, c_shard)
-            decode_in = (p_shard, c_shard, slot_vec, slot_vec, rep)
+            # prefill's emitted K/V carry the cache head sharding (same
+            # kv_dense rules — [1, L, P, h, hd] rides the 5-dim entry
+            # list), so insert never pays a resharding copy
+            kv_seed = layout.resolve_shardings(
+                mesh, {"k": None, "v": None}, prefix="kv_dense"
+            )
+            decode_in = (p_shard, c_shard, slot_vec, slot_vec, scalar)
             decode_out = (rep, rep, c_shard)  # tokens, finite, cache
-            insert_in = (c_shard, rep, rep, rep)
+            insert_in = (c_shard, kv_seed["k"], kv_seed["v"], scalar)
             jit_kw = dict(in_shardings=decode_in, out_shardings=decode_out)
             insert_kw = dict(in_shardings=insert_in, out_shardings=c_shard)
+            prefill_kw = dict(
+                out_shardings=(rep, kv_seed["k"], kv_seed["v"])
+            )
         else:
             jit_kw = {}
             insert_kw = {}
+            prefill_kw = {}
 
         temperature = float(temperature)
         base_rng = self._base_rng
@@ -385,7 +443,7 @@ class InferenceEngine:
         def _decode_fn(params, cache, tokens, pos, step):
             logits, cache = forward_decode(
                 params, tokens, cache, pos, num_heads=num_heads,
-                kernel=dec_kernel,
+                kernel=dec_kernel, mesh=mesh,
             )
             # per-slot health verdict rides the step (one [slots] bool —
             # the NaN-quarantine signal, free next to the token readback)
@@ -413,7 +471,7 @@ class InferenceEngine:
         # distinguishable cost rows
         tag = f"serve.dense.{self.kv_dtype}"
         self._prefill_jit = tracked_jit(
-            f"{tag}.prefill", jax.jit(_prefill_fn)
+            f"{tag}.prefill", jax.jit(_prefill_fn, **prefill_kw)
         )
         self._insert_jit = tracked_jit(f"{tag}.insert", jax.jit(
             _insert_fn, donate_argnums=(0,), **insert_kw
@@ -617,8 +675,10 @@ class PagedInferenceEngine:
     Decode math is bit-identical to the dense engine (the gathered page
     view IS the dense key sequence), so greedy runs produce the same
     tokens under either layout — ``tests/test_paged_cache.py`` pins it.
-    Single-mesh only: the block-table gather crosses the page axis, which
-    would be a cross-device gather under a sharded pool.
+    A ``mesh`` must be tensor-only (``data×fsdp == 1``): the page-pool
+    axis never shards (the block-table gather must stay chip-local), so
+    TP splits weights and the cache's HEAD dim through the partition-rule
+    layout table while page addressing stays on-chip.
     """
 
     def __init__(
@@ -631,6 +691,7 @@ class PagedInferenceEngine:
         page_size: int = 64,
         num_pages: Optional[int] = None,
         prefill_chunk: int = 64,
+        mesh=None,
         temperature: float = 0.0,
         top_k: Optional[int] = None,
         cache_dtype=None,
@@ -664,7 +725,23 @@ class PagedInferenceEngine:
         self.pad_id = pad_id
         # exposed for the spec decoder's greedy-only guard
         self.temperature = float(temperature)
-        self.mesh = None
+        self.mesh = mesh
+        self.tp = (
+            layout.tensor_parallel_size(mesh)
+            if mesh is not None and mesh.devices.size > 1 else 1
+        )
+        self.layout_rules = layout.layout_rules_provenance()
+        if self.tp > 1:
+            if data_parallel_size(mesh) != 1:
+                raise ValueError(
+                    "paged engine meshes must be tensor-only (data×fsdp "
+                    f"== 1): the page pool never shards; got {dict(mesh.shape)}"
+                )
+            if num_heads % self.tp:
+                raise ValueError(
+                    f"num_heads {num_heads} not divisible by the mesh's "
+                    f"tensor axis ({self.tp}) — TP shards attention heads"
+                )
         self.vocab_size = params["head"].shape[1]
         if cache_dtype is None:
             cache_dtype = params["embed"].dtype
@@ -703,6 +780,35 @@ class PagedInferenceEngine:
             dtype=cache_dtype,
         )
         self._page_bytes = page_bytes(self._cache)
+        self._params_sharding = None  # reload re-places onto the same layout
+        if self.tp > 1:
+            # placements resolve through the partition-rule layout table:
+            # weights Megatron-TP, pool head dim over tensor, page axis
+            # chip-local, host plumbing (tables/offsets) replicated
+            p_shard = layout.resolve_shardings(mesh, params, prefix="params")
+            c_shard = cache_sharding(
+                mesh, quantized=self.kv_dtype == "int8", layout="paged"
+            )
+            self._params_sharding = p_shard
+            self.params = jax.device_put(params, p_shard)
+            self._cache = jax.device_put(self._cache, c_shard)
+            rep = layout.replicated(mesh)
+            slot_vec = layout.io_sharding(
+                mesh, "tokens", shape=(batch_slots,)
+            )
+            scalar = layout.io_sharding(mesh, "step", shape=())
+            chunk_kw = dict(
+                in_shardings=(p_shard, c_shard, rep, rep, scalar),
+                out_shardings=(rep, c_shard),
+            )
+            decode_kw = dict(
+                in_shardings=(
+                    p_shard, c_shard, slot_vec, slot_vec, rep, scalar
+                ),
+            )
+        else:
+            chunk_kw = {}
+            decode_kw = {}
         # host-side block tables, one row per slot; scratch-filled rows
         # make released/empty slots write into the dustbin page
         self._block_tables = np.full(
@@ -734,7 +840,7 @@ class PagedInferenceEngine:
             return forward_prefill_chunk(
                 params, tokens, cache, block_table, offset,
                 num_heads=num_heads, page_size=page_size,
-                kernel=dec_kernel,
+                kernel=dec_kernel, mesh=mesh,
             )
 
         def _decode_fn(params, cache, tokens, pos, block_tables, step,
@@ -742,7 +848,7 @@ class PagedInferenceEngine:
             logits, cache = forward_decode_paged(
                 params, tokens, cache, pos, block_tables,
                 num_heads=num_heads, page_size=page_size,
-                kernel=dec_kernel,
+                kernel=dec_kernel, mesh=mesh,
             )
             # per-slot health verdict (NaN quarantine) — one [slots] bool
             finite = jnp.isfinite(logits).all(axis=-1)
@@ -780,10 +886,10 @@ class PagedInferenceEngine:
         # layout+dtype like the dense engine's programs
         tag = f"serve.paged.{self.kv_dtype}"
         self._chunk_jit = tracked_jit(f"{tag}.prefill_chunk", jax.jit(
-            _chunk_fn, donate_argnums=(1,)
+            _chunk_fn, donate_argnums=(1,), **chunk_kw
         ))
         self._decode_jit = tracked_jit(f"{tag}.decode", jax.jit(
-            _decode_fn, donate_argnums=(1,), static_argnums=(6,)
+            _decode_fn, donate_argnums=(1,), static_argnums=(6,), **decode_kw
         ))
         self._sample_jit = jax.jit(_sample)
         self._scrub_jit = tracked_jit(f"{tag}.scrub", jax.jit(
@@ -1151,6 +1257,8 @@ class PagedInferenceEngine:
                 "request_reload does)"
             )
         _check_reload_tree(self.params, params)
+        if self._params_sharding is not None:
+            params = jax.device_put(params, self._params_sharding)
         self.params = params
         self.allocator.clear_prefix()
         logger.info(

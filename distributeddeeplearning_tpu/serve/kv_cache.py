@@ -27,9 +27,8 @@ from typing import Any, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
-from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
+from distributeddeeplearning_tpu.parallel import sharding as _layout
 from distributeddeeplearning_tpu.quant.qtensor import (
     quantize_kv,
     quantized_cache,
@@ -73,20 +72,26 @@ def init_cache(
     return cache
 
 
-def cache_sharding(mesh, *, quantized: bool = False) -> Cache:
-    """NamedShardings for the cache: slots over the data axes, heads over
-    ``tensor`` — the serving analogue of the training batch/TP layout, so
-    an engine built on the training mesh reuses its geometry unchanged.
-    The int8 layout's scale leaves shard identically (they carry the same
-    slot/head dims, just no head_dim)."""
-    spec = P(DATA_AXES, None, None, "tensor", None)
-    s = NamedSharding(mesh, spec)
-    out = {"k": s, "v": s}
+def cache_sharding(
+    mesh, *, quantized: bool = False, layout: str = "dense"
+) -> Cache:
+    """NamedShardings for a cache pytree, resolved through the partition-
+    rule layout table (``parallel.sharding.LAYOUT_RULES``).
+
+    Dense: slots over the data axes, heads over ``tensor`` — the serving
+    analogue of the training batch/TP layout, so an engine built on the
+    training mesh reuses its geometry unchanged.  Paged: the page-pool
+    axis stays chip-local (the block-table gather must not cross chips)
+    and only heads shard over ``tensor``.  The int8 layouts' scale leaves
+    shard identically (same slot/page/head dims, just no head_dim).
+    """
+    if layout not in ("dense", "paged"):
+        raise ValueError(f"unknown cache layout {layout!r}")
+    names = {"k": None, "v": None}
     if quantized:
-        sc = NamedSharding(mesh, P(DATA_AXES, None, None, "tensor"))
-        out["k_scale"] = sc
-        out["v_scale"] = sc
-    return out
+        names["k_scale"] = None
+        names["v_scale"] = None
+    return _layout.resolve_shardings(mesh, names, prefix=f"kv_{layout}")
 
 
 def insert_sequence(cache: Cache, k: jax.Array, v: jax.Array, slot) -> Cache:

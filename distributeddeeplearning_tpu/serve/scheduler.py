@@ -228,6 +228,12 @@ class ServeReport:
     # artifact is distinguishable from an f32 one without diffing configs
     kv_dtype: str = "float32"
     weights_dtype: str = "float32"
+    # layout provenance: tensor-parallel degree the engine served at and
+    # the partition-rule table that placed every array (count + digest,
+    # ``parallel.sharding.layout_rules_provenance``) — a TP_* artifact is
+    # meaningless without knowing which rule table produced the layout
+    tp: int = 1
+    layout_rules: str = ""
     # which attention kernel consumed the cache ("flash" =
     # ops.flash_decode, "gather" = the legacy dense read) — the QUANT
     # artifacts compare the two, so the report must say which ran
@@ -1720,6 +1726,8 @@ class ContinuousBatchingScheduler:
             kv_layout=getattr(engine, "kv_layout", "dense"),
             kv_dtype=getattr(engine, "kv_dtype", "float32"),
             weights_dtype=getattr(engine, "weights_dtype", "float32"),
+            tp=getattr(engine, "tp", 1),
+            layout_rules=getattr(engine, "layout_rules", ""),
             decode_kernel=getattr(engine, "decode_kernel", "gather"),
             prefix_hit_rate=(
                 round(engine.prefix_hit_rate(), 4)

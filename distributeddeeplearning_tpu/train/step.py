@@ -170,13 +170,15 @@ def _state_shardings(mesh, state_example, rules, logical_axes):
 def _comm_opt_shardings(mesh, opt_state):
     """Shardings for a comm-overlap ``{"base", "residual"}`` opt_state:
     per-bucket flat vectors (the WUS optimizer shards and the compression
-    residual) over the data axes, everything else replicated."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
+    residual) over the data axes, everything else replicated — the bucket
+    spec comes out of the partition-rule layout table (``comm/`` rules),
+    not a hand-wired PartitionSpec."""
+    from distributeddeeplearning_tpu.parallel import sharding as _layout
 
     r = replicated(mesh)
-    s = NamedSharding(mesh, P(DATA_AXES))
+    s = _layout.resolve_shardings(
+        mesh, {"bucket": None}, prefix="comm"
+    )["bucket"]
 
     def is_bucket_tuple(x):
         return (
@@ -540,9 +542,9 @@ def _build_comm_overlap_step(
 
     from jax import lax
     from jax.experimental.shard_map import shard_map
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from distributeddeeplearning_tpu.parallel import comms
+    from distributeddeeplearning_tpu.parallel import sharding as _layout
     from distributeddeeplearning_tpu.parallel.mesh import (
         DATA_AXES,
         data_parallel_size,
@@ -557,7 +559,9 @@ def _build_comm_overlap_step(
     )
     b_shard = batch_sharding(mesh)
     r_shard = replicated(mesh)
-    shard_over_data = NamedSharding(mesh, P(DATA_AXES))
+    shard_over_data = _layout.resolve_shardings(
+        mesh, {"bucket": None}, prefix="comm"
+    )["bucket"]
     p_treedef = jax.tree_util.tree_structure(state_example.params)
     base_rng = rng if rng is not None else jax.random.key(0)
     AX = DATA_AXES
@@ -577,7 +581,7 @@ def _build_comm_overlap_step(
     opt_specs = comms.comm_opt_specs(
         state_example.opt_state, p_treedef, layout,
         weight_update_sharding=weight_update_sharding,
-        spec_sharded=P(AX), spec_replicated=P(),
+        spec_sharded=_layout.data_spec(), spec_replicated=_layout.replicated_spec(),
     )
     n_buckets = layout.num_buckets
     residual_shardings = (
@@ -585,7 +589,8 @@ def _build_comm_overlap_step(
         if comm_dtype is not None else ()
     )
     residual_specs = (
-        tuple(P(AX) for _ in range(n_buckets)) if comm_dtype is not None else ()
+        tuple(_layout.data_spec() for _ in range(n_buckets))
+        if comm_dtype is not None else ()
     )
     state_shardings = state_example.replace(
         step=r_shard,
@@ -609,7 +614,9 @@ def _build_comm_overlap_step(
             )
         step_rng = jax.random.fold_in(base_rng, state.step)
         parts = {"inputs": inputs, "labels": labels, "extras": extras}
-        parts_spec = jax.tree_util.tree_map(lambda _: P(AX), parts)
+        parts_spec = jax.tree_util.tree_map(
+            lambda _: _layout.data_spec(), parts
+        )
 
         def inner(params, opt_base, residuals, stats, key, data):
             dev = (
@@ -773,8 +780,15 @@ def _build_comm_overlap_step(
         inner_sm = shard_map(
             inner,
             mesh=mesh,
-            in_specs=(P(), opt_specs, residual_specs, P(), P(), parts_spec),
-            out_specs=(P(), opt_specs, residual_specs, P(), P()),
+            in_specs=(
+                _layout.replicated_spec(), opt_specs, residual_specs,
+                _layout.replicated_spec(), _layout.replicated_spec(),
+                parts_spec,
+            ),
+            out_specs=(
+                _layout.replicated_spec(), opt_specs, residual_specs,
+                _layout.replicated_spec(), _layout.replicated_spec(),
+            ),
             check_rep=False,
         )
         new_params, new_opt, new_res, new_stats, metrics = inner_sm(

@@ -190,3 +190,26 @@ def unsharded_leaf():
         (2, 2, 16, 2), jnp.float32
     )
     return cache_abs, cache_sharding(mesh, quantized=True)
+
+
+def rule_fallthrough_tree():
+    """A serve param tree that grew a leaf name NO partition rule
+    matches — the planted input for ``check_rule_fallthrough``, the
+    layout-table sibling of ``unsharded_leaf``: the leaf would silently
+    replicate on every chip instead of failing loudly."""
+    return {
+        "blocks": {
+            "0": {
+                # matched sibling (the column-parallel qkv rule) — must
+                # NOT fire, pinning that the checker flags only the
+                # fallthrough leaf
+                "qkv": jax.ShapeDtypeStruct((16, 3, 32), jnp.float32),
+                # planted: a LoRA adapter grafted onto the attention
+                # block — no qkv/proj rule matches, no terminal rule
+                # catches it
+                "wq_lora_adapter": jax.ShapeDtypeStruct(
+                    (16, 4), jnp.float32
+                ),
+            }
+        }
+    }

@@ -400,6 +400,50 @@ class TestProgramAuditDetections:
         assert findings[0].checker == "sharding-coverage"
         assert "k_zero_point" in findings[0].message
 
+    def test_rule_table_fallthrough_caught(self, fixtures):
+        """The layout-engine sibling of the unsharded-leaf class: a leaf
+        name no LAYOUT_RULES pattern matches must surface as a
+        sharding-coverage finding at the planted file:line — and the
+        matched sibling leaf (qkv) must NOT fire."""
+        from distributeddeeplearning_tpu.analysis.program_audit import (
+            check_rule_fallthrough,
+        )
+
+        path = FIXTURES / "programs.py"
+        line = _line_of(path, "wq_lora_adapter")
+        findings = check_rule_fallthrough(
+            fixtures.rule_fallthrough_tree(), prefix="params",
+            name="fixture.params", path=str(path), line=line,
+        )
+        assert len(findings) == 1, format_findings(findings)
+        f = findings[0]
+        assert f.checker == "sharding-coverage"
+        assert "params/blocks/0/wq_lora_adapter" in f.message
+        assert f.path.endswith("programs.py") and f.line == line
+        assert "LAYOUT_RULES" in (f.hint or "")
+
+    def test_rule_table_audit_armed_on_live_tree(self):
+        """Non-vacuity: the hot-program rule-table sweep inside
+        check_sharding_coverage actually consults the layout table — an
+        empty rule table must produce fallthrough findings pointing at
+        parallel/sharding.py, while the real table stays clean."""
+        from unittest import mock
+
+        from distributeddeeplearning_tpu.analysis import program_audit
+        from distributeddeeplearning_tpu.parallel import sharding
+
+        assert program_audit.check_sharding_coverage() == []
+        with mock.patch.object(sharding, "LAYOUT_RULES", ()):
+            findings = program_audit.check_sharding_coverage()
+        fallthrough = [
+            f for f in findings if "matches NO rule" in f.message
+        ]
+        assert fallthrough, format_findings(findings)
+        assert all(
+            f.path.endswith("parallel/sharding.py") and f.line > 0
+            for f in fallthrough
+        )
+
 
 # --------------------------------------------------------------------------
 # clean-tree gates + registry coverage pins

@@ -1,7 +1,10 @@
-"""Sharding rules and batch placement."""
+"""Sharding rules and batch placement — both rule systems: the
+logical-axis training rules and the partition-rule layout table."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from distributeddeeplearning_tpu.data.synthetic import synthetic_batch
@@ -13,10 +16,16 @@ from distributeddeeplearning_tpu.parallel import (
     replicated,
     shard_batch,
 )
+from distributeddeeplearning_tpu.parallel.mesh import DATA_AXES
 from distributeddeeplearning_tpu.parallel.sharding import (
+    LAYOUT_RULES,
     RULES_FSDP,
     RULES_TP,
+    layout_rules_provenance,
     logical_to_spec,
+    match_partition_rules,
+    spec_for,
+    unmatched_leaves,
 )
 
 
@@ -59,3 +68,94 @@ def test_logical_to_spec_tp():
 def test_logical_to_spec_unmatched_replicates():
     spec = logical_to_spec((None, "nonexistent"), RULES_TP)
     assert spec == P(None, None)
+
+
+# ---------------------------------------------------------------------------
+# the partition-rule layout table (match_partition_rules and friends)
+# ---------------------------------------------------------------------------
+
+
+def _tp_mesh():
+    """data=1 × tensor=2 over the first two virtual-pod devices."""
+    return create_mesh(
+        MeshSpec(data=1, tensor=2), devices=jax.devices()[:2]
+    )
+
+
+def test_rule_table_first_match_wins():
+    # the io/ namespace rule sits ABOVE the terminal (^|/)pos$ replicate
+    # rule, so io/pos binds to the data axes while a param pos replicates
+    assert spec_for("io/pos", shape=(4,)) == P(DATA_AXES)
+    assert spec_for("params/pos", shape=(64, 16)) == P()
+    # synthetic table: the broad pattern shadows the specific one below it
+    rules = ((r"w", ("tensor",)), (r"^w$", (None, "tensor")))
+    assert spec_for("w", shape=(8, 8), rules=rules) == P("tensor")
+
+
+def test_rule_table_axis_used_once():
+    # XLA forbids one mesh axis on two dims of one leaf: the second use
+    # drops (first wins), trailing replicated dims trim off the spec
+    rules = ((r"^dup$", ("tensor", "tensor")),)
+    assert spec_for("dup", shape=(4, 4), rules=rules) == P("tensor")
+
+
+def test_rule_table_qtensor_scale_leaves():
+    """QTensor scale leaves (axis=-2 keepdims quantization): column-
+    parallel scales shard with their values' output dim; row-parallel
+    scales' contracted dim collapses to size 1, which the divisibility
+    drop de-shards — scales replicate exactly when they must."""
+    mesh = _tp_mesh()
+    # column-parallel w_in: values [L, d, d_ff], scales [L, 1, d_ff]
+    assert spec_for(
+        "params/blocks/w_in/values", shape=(2, 16, 24), mesh=mesh
+    ) == P(None, None, "tensor")
+    assert spec_for(
+        "params/blocks/w_in/scales", shape=(2, 1, 24), mesh=mesh
+    ) == P(None, None, "tensor")
+    # row-parallel w_out: values [L, d_ff, d] contract over tensor;
+    # scales [L, 1, d] lose the mapping to the divisibility drop
+    assert spec_for(
+        "params/blocks/w_out/values", shape=(2, 24, 16), mesh=mesh
+    ) == P(None, "tensor")
+    assert spec_for(
+        "params/blocks/w_out/scales", shape=(2, 1, 16), mesh=mesh
+    ) == P()
+
+
+def test_rule_table_divisibility_drop():
+    mesh = _tp_mesh()  # tensor=2
+    # vocab-parallel head [d, V]: an odd vocab cannot split over 2 chips
+    assert spec_for("params/head", shape=(16, 33), mesh=mesh) == P()
+    assert spec_for("params/head", shape=(16, 32), mesh=mesh) == P(
+        None, "tensor"
+    )
+
+
+def test_match_partition_rules_strict_raises_on_fallthrough():
+    with pytest.raises(ValueError, match="wq_lora"):
+        match_partition_rules(
+            {"wq_lora": jax.ShapeDtypeStruct((4, 4), jnp.float32)},
+            prefix="params",
+        )
+
+
+def test_match_partition_rules_none_placeholders_resolve_by_name():
+    # name-only trees ({"bucket": None}) resolve by path alone — JAX
+    # would otherwise flatten None into empty structure and skip the rule
+    specs = match_partition_rules({"bucket": None}, prefix="comm")
+    assert specs["bucket"] == P(DATA_AXES)
+
+
+def test_unmatched_leaves_scalars_exempt():
+    tree = {
+        "mystery": jax.ShapeDtypeStruct((4,), jnp.float32),
+        "scalar": jax.ShapeDtypeStruct((), jnp.float32),
+    }
+    assert unmatched_leaves(tree, prefix="params") == ["params/mystery"]
+
+
+def test_layout_rules_provenance_tracks_table_content():
+    tag = layout_rules_provenance()
+    assert tag.startswith(f"LAYOUT_RULES#{len(LAYOUT_RULES)}@")
+    # a silent table edit must change the stamp
+    assert layout_rules_provenance(LAYOUT_RULES[:-1]) != tag
