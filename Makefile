@@ -13,7 +13,7 @@ IMAGE ?= ddlt-control
 DATA_DIR ?= /data
 
 .PHONY: install test test-fast lint perf-history obs-gate generate clean \
-        bench-smoke bench scaling bench-tp dryrun docker-build docker-run \
+        bench-smoke bench scaling bench-tp bench-tier dryrun docker-build docker-run \
         docker-bash docker-stop
 
 install:
@@ -75,6 +75,13 @@ scaling:
 # decode roofline.
 bench-tp:
 	python bench.py --tp 2
+
+# Host-memory KV page tier benchmark (TIER_r{NN}.json): bit-identical
+# spill/restore, prefix-hit rate and admitted-tokens/HBM-byte at 4-10x
+# session oversubscription vs the no-tier baseline, decode parity when
+# the working set fits in HBM.
+bench-tier:
+	python bench.py --tier
 
 # Multi-chip sharding dry run on a virtual 8-device pod (the XLA_FLAGS
 # hint lets utils/virtual_pod pin the CPU platform without touching the

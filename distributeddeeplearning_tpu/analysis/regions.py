@@ -124,6 +124,41 @@ HOT_REGIONS: Tuple[HotRegion, ...] = (
         landmarks=("st.generated", "self._class_rank"),
         sync_budget=0,
     ),
+    HotRegion(
+        name="kv-tier-spill",
+        module="distributeddeeplearning_tpu.serve.kv_tier",
+        qualname="HostPageTier.spill_in",
+        # the host tier's ONE designed sync: the D2H page readback that
+        # copies a cold page's leaves (k/v values AND quant scales) into
+        # the pinned host pool.  Exactly one marked np.asarray — a
+        # second readback here doubles the spill cost of every demotion.
+        landmarks=("np.asarray(",),
+        sync_budget=1,
+    ),
+    HotRegion(
+        name="kv-tier-prefetch",
+        module="distributeddeeplearning_tpu.serve.kv_tier",
+        qualname="HostPageTier.dispatch_restore",
+        # the restore path must stay ASYNC: jax.device_put dispatches
+        # the H2D transfer and returns immediately — the landmark pins
+        # that dispatch shape, and ANY sync token here would turn the
+        # prefetch the admission gate overlaps with decode into a stall.
+        landmarks=("jax.device_put(",),
+        sync_budget=0,
+    ),
+    HotRegion(
+        name="serve-tier-pump",
+        module="distributeddeeplearning_tpu.serve.scheduler",
+        qualname="ContinuousBatchingScheduler._tier_pump",
+        # one pass per scheduler iteration: retire landed prefetches,
+        # then demote the coldest reclaimable pages when the free-page
+        # cushion or the HBM forecast says pressure is near.  The
+        # designed D2H sync lives inside HostPageTier.spill_in (its own
+        # region above) — THIS body reads host counters and the ledger
+        # forecast only, so it budgets 0.
+        landmarks=("engine.tier_inflight(", "engine.spill_cold_pages("),
+        sync_budget=0,
+    ),
 )
 
 #: Jitted step builders: no host-sync token at all — inside jit it would

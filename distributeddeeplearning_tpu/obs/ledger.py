@@ -157,12 +157,18 @@ class HBMLedger:
     ):
         self._lock = threading.Lock()
         self._providers: List[_Provider] = []
+        # HOST-memory owners (the KV tier's pinned page pool): attributed
+        # in snapshots/gauges so the bytes are never invisible, but kept
+        # OUT of committed_bytes()/forecast() — host RAM is not HBM, and
+        # charging it against device capacity would starve admission
+        self._host_providers: List[_Provider] = []
         self._next_handle = 0
         self._capacity = capacity_bytes
         self._capacity_probed = capacity_bytes is not None
         self.residual_limit_pct = float(residual_limit_pct)
         # high-watermarks, updated on every snapshot()/forecast()
         self.watermarks: Dict[str, int] = {}
+        self.host_watermarks: Dict[str, int] = {}
         self.peak_total_bytes = 0
         self.peak_committed_bytes = 0
 
@@ -214,15 +220,66 @@ class HBMLedger:
             )
             return handle
 
+    def register_host(
+        self, owner: str, target: Any, bytes_fn: Callable[[Any], int]
+    ) -> int:
+        """Register a HOST-memory byte source (e.g. the KV tier's pinned
+        page pool under ``kv_host_pages``).  ``bytes_fn(target)`` returns
+        the host bytes currently committed.  Host owners appear in every
+        snapshot (``host_owners`` / ``host_total_bytes``) and export as
+        ``hbm.<owner>.*`` gauges so fleet watermarks pick them up, but
+        they never count toward :meth:`committed_bytes` or
+        :meth:`forecast` — spilling to host must CREATE device headroom,
+        not relocate the charge.  Same weakref lifetime as
+        :meth:`register`."""
+        try:
+            ref = weakref.ref(target)
+        except TypeError:
+            def ref(_t=target):
+                return _t
+
+        def fn():
+            obj = ref()
+            return None if obj is None else bytes_fn(obj)
+
+        with self._lock:
+            handle = self._next_handle
+            self._next_handle += 1
+            self._host_providers.append(
+                _Provider(owner, ref, fn, None, handle)
+            )
+            return handle
+
     def unregister(self, handle: int) -> None:
         with self._lock:
             self._providers = [
                 p for p in self._providers if p.handle != handle
             ]
+            self._host_providers = [
+                p for p in self._host_providers if p.handle != handle
+            ]
 
     def owners(self) -> List[str]:
         with self._lock:
             return sorted({p.owner for p in self._providers})
+
+    def host_owners(self) -> List[str]:
+        with self._lock:
+            return sorted({p.owner for p in self._host_providers})
+
+    def _walk_host(self) -> Dict[str, int]:
+        with self._lock:
+            self._host_providers = [
+                p for p in self._host_providers if not p.dead
+            ]
+            providers = list(self._host_providers)
+        out: Dict[str, int] = {}
+        for p in providers:
+            b = p.fn()
+            out[p.owner] = out.get(p.owner, 0) + (
+                int(b) if b is not None else 0
+            )
+        return out
 
     # -- capacity ----------------------------------------------------------
     def set_capacity(self, capacity_bytes: Optional[int]) -> None:
@@ -310,6 +367,10 @@ class HBMLedger:
         self.peak_committed_bytes = max(
             self.peak_committed_bytes, committed
         )
+        host_bytes = self._walk_host()
+        for owner, b in host_bytes.items():
+            if b > self.host_watermarks.get(owner, 0):
+                self.host_watermarks[owner] = b
         out: Dict[str, Any] = {
             "owners": {
                 owner: {
@@ -325,6 +386,18 @@ class HBMLedger:
             "per_device_bytes": dict(sorted(per_device.items())),
             "capacity_bytes": self.capacity_bytes,
             "residual_limit_pct": self.residual_limit_pct,
+            # host-memory owners ride along OUTSIDE the device totals:
+            # attributed (a spilled KV page is a real byte someone owns)
+            # but never reconciled against live DEVICE arrays and never
+            # charged to the HBM admission forecast
+            "host_owners": {
+                owner: {
+                    "bytes": host_bytes[owner],
+                    "peak_bytes": self.host_watermarks.get(owner, 0),
+                }
+                for owner in sorted(host_bytes)
+            },
+            "host_total_bytes": sum(host_bytes.values()),
         }
         if reconcile:
             live = live_device_bytes()
@@ -406,6 +479,16 @@ class HBMLedger:
         )
         registry.gauge("hbm.committed_total_bytes").set(
             snap["committed_total_bytes"]
+        )
+        # host owners share the hbm.* namespace so the fleet's existing
+        # per-replica watermark lift carries them with no new channel
+        for owner, row in snap["host_owners"].items():
+            registry.gauge(f"hbm.{owner}.bytes").set(row["bytes"])
+            registry.gauge(f"hbm.{owner}.peak_bytes").set(
+                row["peak_bytes"]
+            )
+        registry.gauge("hbm.host_total_bytes").set(
+            snap["host_total_bytes"]
         )
 
 

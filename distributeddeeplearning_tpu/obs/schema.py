@@ -66,6 +66,7 @@ __all__ = [
     "validate_attrib_payload",
     "validate_overload_payload",
     "validate_tp_payload",
+    "validate_tier_payload",
 ]
 
 #: latency blocks whose percentile keys are a cross-artifact contract
@@ -995,6 +996,87 @@ def validate_tp_payload(payload: Dict[str, Any]) -> None:
         raise SchemaError("; ".join(errors))
 
 
+def validate_tier_payload(payload: Dict[str, Any]) -> None:
+    """Strict schema for the ``TIER_r{NN}.json`` artifact body.
+
+    The host-memory KV page tier's evidence trail (``bench.py --tier``):
+    the artifact must carry the host-pool size, the per-config
+    bit-identity verdicts (a spilled-then-restored greedy stream MUST
+    equal the never-spilled run on both layouts and both cache dtypes —
+    anything else means the tier corrupts decodes), the prefix-hit-rate
+    pair (tier vs no-tier baseline at the same oversubscription), the
+    admitted-tokens-per-computed-HBM-byte ratio, the fits-in-HBM decode
+    throughput ratio, and all four gate booleans — the leaves
+    ``ddlt obs history --gate`` tracks across revisions.
+    """
+    errors: List[str] = []
+
+    def require(cond: bool, msg: str) -> None:
+        if not cond:
+            errors.append(msg)
+
+    for key in ("metric", "value", "unit", "bench_revision", "platform",
+                "virtual_pod", "host_pages", "tier_policy",
+                "oversubscription", "dims", "configs", "bit_identical",
+                "gates"):
+        require(key in payload, f"missing top-level key {key!r}")
+
+    require(
+        isinstance(payload.get("host_pages"), int)
+        and payload.get("host_pages", 0) >= 1,
+        "host_pages must be an int >= 1 (a tier artifact without a host "
+        "pool measured nothing)",
+    )
+    require(
+        isinstance(payload.get("oversubscription"), (int, float))
+        and payload.get("oversubscription", 0) >= 4,
+        "oversubscription must be >= 4 (the spec's session-to-HBM "
+        "pressure floor — below it the tier is never exercised)",
+    )
+    for key in ("tier_prefix_hit_rate", "tier_prefix_hit_rate_no_tier",
+                "tier_tokens_per_hbm_byte_ratio",
+                "tier_decode_tokens_per_sec_ratio"):
+        require(
+            isinstance(payload.get(key), (int, float)),
+            f"{key} must be numeric (a tracked tier leaf)",
+        )
+
+    bit = payload.get("bit_identical")
+    if isinstance(bit, dict) and bit:
+        for name, verdict in bit.items():
+            require(
+                isinstance(verdict, bool),
+                f"bit_identical[{name!r}] must be a bool",
+            )
+    else:
+        require(False, "bit_identical must be a non-empty dict of "
+                       "per-config spill/restore verdicts")
+
+    configs = payload.get("configs")
+    if isinstance(configs, dict) and configs:
+        for name, cfg in configs.items():
+            require(
+                isinstance(cfg, dict),
+                f"configs[{name!r}] must be a dict",
+            )
+    else:
+        require(False, "configs must be a non-empty dict")
+
+    gates = payload.get("gates")
+    if isinstance(gates, dict):
+        for gk in ("bit_identical", "prefix_hit_rate",
+                   "tokens_per_hbm_byte", "decode_tokens_per_sec"):
+            require(
+                isinstance(gates.get(gk), bool),
+                f"gates.{gk} must be a bool",
+            )
+    else:
+        require(False, "gates must be a dict")
+
+    if errors:
+        raise SchemaError("; ".join(errors))
+
+
 #: Ordered most-specific-first: the FIRST matching prefix wins, so a
 #: name matching two prefixes (``OBS_FLEET_*`` also matches ``OBS_*``)
 #: binds to its specific schema, and every specific kind — ``GOODPUT_*``
@@ -1010,6 +1092,7 @@ _PREFIX_VALIDATORS = (
     ("ATTRIB_", validate_attrib_payload),
     ("OVERLOAD_", validate_overload_payload),
     ("TP_", validate_tp_payload),
+    ("TIER_", validate_tier_payload),
 )
 
 

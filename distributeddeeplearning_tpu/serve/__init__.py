@@ -9,6 +9,10 @@ loop (the Podracer actor/learner decomposition, arxiv 2104.06272):
   dense cache pytree sharded over the training mesh's axes, and a PAGED
   pool of fixed-size pages with a host-side allocator (refcounts, free
   list, reusable-prefix table) so HBM is committed per actual token;
+- :mod:`serve.kv_tier` — a host-memory page tier beneath the paged pool:
+  cold refcounted prefix pages spill HBM→host (values AND quant scales,
+  so restore is bit-identical) and prefetch back asynchronously on a
+  prefix hit or preemption resume;
 - :mod:`serve.engine` — jitted prefill (the Pallas flash-attention prompt
   pass) and single-token decode with cache donation, plus greedy /
   temperature / top-k sampling under the train-step RNG convention; the
@@ -37,6 +41,10 @@ from distributeddeeplearning_tpu.serve.fleet import (
     FleetRouter,
     ReplicaSpec,
     serve_fleet,
+)
+from distributeddeeplearning_tpu.serve.kv_tier import (
+    TIER_POLICIES,
+    HostPageTier,
 )
 from distributeddeeplearning_tpu.serve.kv_cache import (
     OutOfPages,
@@ -79,6 +87,8 @@ __all__ = [
     "pages_for",
     "OutOfPages",
     "PageAllocator",
+    "HostPageTier",
+    "TIER_POLICIES",
     "Request",
     "CompletedRequest",
     "ContinuousBatchingScheduler",

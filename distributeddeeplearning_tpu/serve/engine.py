@@ -66,6 +66,7 @@ from distributeddeeplearning_tpu.serve.kv_cache import (
     page_bytes,
     pages_for,
 )
+from distributeddeeplearning_tpu.serve.kv_tier import HostPageTier
 
 logger = logging.getLogger("ddlt.serve.engine")
 
@@ -102,6 +103,11 @@ def _leaf_subset_page_bytes(cache, *, scales: bool) -> int:
     )
 
 
+def _ledger_host_tier_bytes(engine):
+    tier = getattr(engine, "tier", None)
+    return 0 if tier is None else tier.used_bytes()
+
+
 def _register_engine_owners(engine, ledger=None) -> None:
     """Put the engine's device state on the HBM ledger (default: the
     process ledger) by semantic owner: weights under ``params``, K/V
@@ -109,7 +115,10 @@ def _register_engine_owners(engine, ledger=None) -> None:
     ``kv_scales`` — the decomposition the attribution artifact and the
     crash dumps report.  Paged engines also report COMMITTED bytes
     (pages actually in use × per-page bytes) so the admission forecast
-    prices demand, not the preallocated reservation."""
+    prices demand, not the preallocated reservation.  An attached host
+    tier registers its pool under ``kv_host_pages`` as a HOST owner:
+    attributed in snapshots and fleet watermarks, excluded from the HBM
+    forecast (host RAM is not device memory)."""
     if ledger is None:
         ledger = get_ledger()
     ledger.register("params", engine, _ledger_params)
@@ -133,6 +142,10 @@ def _register_engine_owners(engine, ledger=None) -> None:
             )
         else:
             ledger.register("kv_scales", engine, _ledger_kv_scales)
+    if getattr(engine, "tier", None) is not None:
+        ledger.register_host(
+            "kv_host_pages", engine, _ledger_host_tier_bytes
+        )
 
 
 def sample_logits(
@@ -700,6 +713,8 @@ class PagedInferenceEngine:
         prefix_cache: bool = True,
         capture_logits: bool = False,
         decode_kernel: str = "auto",
+        host_pages: int = 0,
+        tier_policy: str = "lru",
     ):
         _, num_layers, head_dim = _validate_model_dims(
             params, num_heads=num_heads, max_seq=max_seq, top_k=top_k
@@ -780,6 +795,15 @@ class PagedInferenceEngine:
             dtype=cache_dtype,
         )
         self._page_bytes = page_bytes(self._cache)
+        # host page tier (serve/kv_tier.py): host_pages = 0 disables it;
+        # otherwise alloc-pressure evictions demote to host instead of
+        # forgetting, and the prefix walk restores host hits by DMA
+        self.tier: Optional[HostPageTier] = None
+        if host_pages:
+            self.tier = HostPageTier(
+                self._cache, host_pages, policy=tier_policy
+            )
+            self.allocator.set_evict_hook(self._tier_evict_hook)
         self._params_sharding = None  # reload re-places onto the same layout
         if self.tp > 1:
             # placements resolve through the partition-rule layout table:
@@ -822,6 +846,9 @@ class PagedInferenceEngine:
         self.prefix_hit_tokens = 0
         self.prompt_tokens_seen = 0
         self.pages_peak = 0
+        # subset of prefix_hit_tokens answered from the HOST tier (a
+        # DMA restore instead of a resident page) — the tier's win line
+        self.prefix_hit_tokens_host = 0
 
         temperature = float(temperature)
         base_rng = self._base_rng
@@ -921,6 +948,13 @@ class PagedInferenceEngine:
         (the pay-per-token number the paged layout is for)."""
         return self.pages_peak * self._page_bytes
 
+    @property
+    def page_bytes_each(self) -> int:
+        """Bytes one pool page holds across every leaf — the granule
+        ``admit_bytes`` multiplies and the spill pump prices headroom
+        in."""
+        return self._page_bytes
+
     def prefix_hit_rate(self) -> float:
         if not self.prompt_tokens_seen:
             return 0.0
@@ -933,9 +967,14 @@ class PagedInferenceEngine:
         self.prefix_hit_tokens = 0
         self.prompt_tokens_seen = 0
         self.pages_peak = 0
+        self.prefix_hit_tokens_host = 0
+        if self.tier is not None:
+            self.tier.reset_stats()
 
     def clear_prefix_cache(self) -> None:
         self.allocator.clear_prefix()
+        if self.tier is not None:
+            self.tier.clear()
 
     def chunk_shapes(self, prompt_len: int) -> set:
         """The compiled chunk widths a prompt of ``prompt_len`` will run
@@ -1023,13 +1062,26 @@ class PagedInferenceEngine:
         # prefix reuse: walk the chain of FULL prompt pages.  Capped at
         # length-1 tokens so at least the last prompt token always runs
         # through prefill — its logits seed the first sampled token.
+        # The prefix table answers in EITHER tier: a resident hit maps
+        # the page, a host hit allocates a fresh page and dispatches the
+        # async restore into it (prefetch-aware prefill — the chunk
+        # program consuming the page orders after the H2D transfer, so
+        # no explicit wait sits on this path).
         shared: list = []
+        restored = 0
         if self._prefix_enabled:
             max_shared = (length - 1) // ps
             for i in range(max_shared):
-                page = self.allocator.lookup_prefix(
-                    self._prefix_key(prompt, i + 1)
-                )
+                key = self._prefix_key(prompt, i + 1)
+                page = self.allocator.lookup_prefix(key)
+                if (
+                    page is None
+                    and self.tier is not None
+                    and self.allocator.tier_state(key) == "host"
+                ):
+                    page = self._prefetch_page(key)
+                    if page is not None:
+                        restored += 1
                 if page is None:
                     break
                 shared.append(page)
@@ -1053,6 +1105,7 @@ class PagedInferenceEngine:
         offset = len(shared) * ps
         self.prompt_tokens_seen += length
         self.prefix_hit_tokens += offset
+        self.prefix_hit_tokens_host += restored * ps
         return PrefillTask(slot, prompt, pages, offset, offset)
 
     def prefill_step(self, task: PrefillTask) -> Optional[int]:
@@ -1100,9 +1153,18 @@ class PagedInferenceEngine:
             first_new = chunk_start // self.page_size
             last_full = min(task.offset, length) // self.page_size
             for i in range(first_new, last_full):
-                self.allocator.register_prefix(
-                    self._prefix_key(task.prompt, i + 1), task.pages[i]
-                )
+                key = self._prefix_key(task.prompt, i + 1)
+                if (
+                    self.tier is not None
+                    and self.allocator.tier_state(key) == "host"
+                ):
+                    # this chunk just recomputed the page (the walk stops
+                    # before the final prompt page, so its host copy was
+                    # unreachable there) — the fresh resident page
+                    # supersedes the bit-identical host copy
+                    self.tier.drop(key)
+                    self.allocator.drop_host(key)
+                self.allocator.register_prefix(key, task.pages[i])
         if not task.done:
             return None
         # prompt fully written: NOW the slot's decode row may see the pages
@@ -1236,6 +1298,98 @@ class PagedInferenceEngine:
             self.allocator.decref(page)
         self._block_tables[slot] = SCRATCH_PAGE
 
+    # -- host page tier ----------------------------------------------------
+    def _tier_evict_hook(self, key, page: int) -> bool:
+        """Alloc-pressure demotion (installed on the allocator): copy the
+        about-to-be-recycled reclaimable page host-side so its key keeps
+        answering prefix hits.  False (eviction forgets the key) only
+        when the host pool can take nothing right now."""
+        evicted = self.tier.spill_in(self._cache, key, page)
+        if evicted is None:
+            return False
+        for k in evicted:
+            self.allocator.drop_host(k)
+        return True
+
+    def _prefetch_page(self, key):
+        """Restore a host-tier prefix chunk into a fresh HBM page:
+        allocate, dispatch the async H2D transfer, commit the page into
+        the pool, and hand ownership to the prefix table (refcount 0 →
+        reclaimable, exactly like a resident prefix page; the caller's
+        incref takes the slot's reference).  None when the pool has no
+        page for it — the walk stops and the tail re-prefills."""
+        try:
+            (page,) = self.allocator.alloc(1)
+        except OutOfPages:
+            return None
+        dev = self.tier.dispatch_restore(key)
+        c = dict(self._cache)
+        for name, leaf in dev.items():
+            c[name] = c[name].at[page].set(leaf)
+        self._cache = c
+        self.allocator.restore_prefix(key, page)
+        self.allocator.decref(page)
+        return page
+
+    def spill_cold_pages(self, max_pages: int) -> int:
+        """The spill pump's primitive: demote up to ``max_pages`` LRU
+        reclaimable prefix pages to the host tier, returning their HBM
+        pages to the free list.  Returns pages actually spilled.  Only
+        refcount-0 pages are candidates — a decode-active page is never
+        spilled (its bytes are in flight on device this iteration)."""
+        if self.tier is None or max_pages <= 0:
+            return 0
+        spilled = 0
+        for key, page in self.allocator.coldest_reclaimable(max_pages):
+            evicted = self.tier.spill_in(self._cache, key, page)
+            if evicted is None:
+                break
+            for k in evicted:
+                self.allocator.drop_host(k)
+            self.allocator.spill_prefix(key)
+            spilled += 1
+        return spilled
+
+    def spill_slot_pages(self, slot: int, tokens: Sequence[int]) -> int:
+        """Preemption-resume path: demote the slot's PRIVATE full pages
+        to the host tier keyed by their token history (``tokens`` =
+        prompt + generated so far), so the retry's prefix walk restores
+        them by DMA instead of re-prefilling.  Pages already answering
+        in either tier (shared prompt prefixes) are skipped — they
+        survive preemption on their own.  Call BEFORE ``release``:
+        the copies need the pages still mapped and unrecycled."""
+        if self.tier is None:
+            return 0
+        pages = self._slot_pages.get(slot, [])
+        ps = self.page_size
+        n_full = min(len(tokens) // ps, len(pages))
+        spilled = 0
+        for i in range(n_full):
+            key = self._prefix_key(tokens, i + 1)
+            if self.allocator.tier_state(key) is not None:
+                continue
+            if self.allocator.is_shared(pages[i]):
+                continue
+            evicted = self.tier.spill_in(self._cache, key, pages[i])
+            if evicted is None:
+                break
+            for k in evicted:
+                self.allocator.drop_host(k)
+            self.allocator.host_prefix(key)
+            spilled += 1
+        return spilled
+
+    def tier_inflight(self) -> int:
+        """Retire landed prefetches; how many H2D restores are still in
+        flight (the scheduler's admit gate polls this)."""
+        return 0 if self.tier is None else self.tier.poll()
+
+    def drain_tier(self) -> None:
+        """Fence every in-flight prefetch (blocking) — the admission
+        gate's last resort before it would preempt a victim."""
+        if self.tier is not None:
+            self.tier.drain()
+
     # -- live weight reload ------------------------------------------------
     def reload_params(self, params) -> None:
         """Swap the engine's weight set IN PLACE (see the dense engine's
@@ -1261,6 +1415,11 @@ class PagedInferenceEngine:
             params = jax.device_put(params, self._params_sharding)
         self.params = params
         self.allocator.clear_prefix()
+        # host-tier pages hold OLD-weight K/V too — a post-reload restore
+        # of one would break fresh-engine bit-exactness just as surely as
+        # a resident stale prefix page
+        if self.tier is not None:
+            self.tier.clear()
         logger.info(
             "paged engine: params reloaded in place, prefix cache dropped"
         )

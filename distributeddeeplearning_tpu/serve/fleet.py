@@ -128,6 +128,11 @@ class ReplicaSpec:
     prefix_cache: bool = True          # paged engines only
     prefill_attention: str = "flash"   # dense engines only
     cache_dtype: Optional[str] = None  # e.g. "int8"
+    # host-memory KV page tier (serve/kv_tier.py, paged engines only):
+    # 0 disables; >0 gives each replica a pinned host pool of that many
+    # pages for spilled cold prefix pages
+    host_pages: int = 0
+    tier_policy: str = "lru"
     decode_kernel: str = "auto"        # "auto" | "flash" | "gather"
     temperature: float = 0.0
     top_k: Optional[int] = None
@@ -180,6 +185,15 @@ class ReplicaSpec:
         if self.preempt_budget < 0:
             raise ValueError(
                 f"preempt_budget must be >= 0, got {self.preempt_budget}"
+            )
+        if self.host_pages < 0:
+            raise ValueError(
+                f"host_pages must be >= 0, got {self.host_pages}"
+            )
+        if self.host_pages and self.kv_layout != "paged":
+            raise ValueError(
+                "host_pages requires kv_layout='paged' (the host tier "
+                "spills KV pages; a dense cache has none)"
             )
 
 
@@ -249,6 +263,15 @@ class FleetReport:
     # here — which replica is closest to the memory cliff, by semantic
     # owner, without a new wire channel
     hbm_watermarks: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict
+    )
+    # per-replica host-tier watermarks (serve/kv_tier.py): the
+    # ``serve.tier.*`` spill/restore/drop counters and host-pool peak
+    # each worker rolls up at end of run, lifted per (replica, pid)
+    # incarnation like hbm_watermarks — which replica is thrashing its
+    # host pool, without a new wire channel.  Host BYTES ride
+    # hbm_watermarks as ``hbm.kv_host_pages.*`` (ledger owner).
+    tier_watermarks: Dict[str, Dict[str, float]] = dataclasses.field(
         default_factory=dict
     )
     # per-priority-class accounting on the ROUTER clock (PR 17): volume,
@@ -333,6 +356,8 @@ def _build_engine(spec: ReplicaSpec):
             cache_dtype=cache_dtype,
             rng=jax.random.key(spec.seed),
             decode_kernel=spec.decode_kernel,
+            host_pages=spec.host_pages,
+            tier_policy=spec.tier_policy,
         )
     engine, _ = data_parallel_engine(
         params,
@@ -403,6 +428,33 @@ def _hbm_watermarks(metric_states) -> Dict[str, Dict[str, float]]:
                 f"-{state.get('pid', '?')}"
             )
             out[key] = gauges
+    return out
+
+
+def _tier_watermarks(metric_states) -> Dict[str, Dict[str, float]]:
+    """Per-replica host-tier watermark frames lifted out of the shipped
+    registry states — the ``serve.tier.*`` spill/restore/drop counters
+    and host-pool peak gauge, keyed ``replicaK-pid`` like
+    :func:`_hbm_watermarks`.  Empty for replicas serving without a tier
+    (the counters never move, the gauge is never set)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for state in metric_states:
+        frame = {
+            name: value
+            for name, value in (state.get("counters") or {}).items()
+            if name.startswith("serve.tier.")
+        }
+        frame.update({
+            name: g.get("value")
+            for name, g in (state.get("gauges") or {}).items()
+            if name.startswith("serve.tier.")
+        })
+        if frame:
+            key = (
+                f"replica{state.get('replica_id', '?')}"
+                f"-{state.get('pid', '?')}"
+            )
+            out[key] = frame
     return out
 
 
@@ -1757,6 +1809,7 @@ class FleetRouter:
             ),
             flight_recorder_dumps=router_dumps + self._worker_dumps,
             hbm_watermarks=_hbm_watermarks(metric_states),
+            tier_watermarks=_tier_watermarks(metric_states),
             per_class=per_class,
         )
         reg = get_registry()

@@ -232,6 +232,17 @@ class PageAllocator:
     FULL token history through the end of that page (the engine uses
     ``tuple(prompt[: (i+1) * page_size])``), so a hit guarantees the
     page's K/V are bit-identical to what prefill would recompute.
+
+    With a host tier attached (:mod:`serve.kv_tier`) a prefix key has a
+    third place to live beyond resident-in-HBM and gone: **host** — the
+    chunk's K/V bytes sit in the pinned host pool and its HBM page id
+    has been returned to the free list.  The allocator tracks host-tier
+    keys so the prefix table answers hits in either tier
+    (:meth:`tier_state`); the byte copies themselves belong to the tier
+    object — the allocator only moves bookkeeping, and the ordering
+    contract is copy-then-:meth:`spill_prefix` /
+    alloc-copy-then-:meth:`restore_prefix` so contents are always valid
+    in at least one tier.
     """
 
     def __init__(self, num_pages: int):
@@ -244,6 +255,15 @@ class PageAllocator:
         self._prefix: Dict[Any, int] = {}
         self._page_key: Dict[int, Any] = {}
         self._reclaim: "OrderedDict[int, None]" = OrderedDict()
+        # prefix keys whose contents live ONLY in the host tier (no HBM
+        # page); insertion-ordered so the host pool can evict LRU
+        self._host: "OrderedDict[Any, None]" = OrderedDict()
+        # alloc-pressure demotion hook (serve/kv_tier.py): called as
+        # hook(key, page) BEFORE an evicted reclaimable page is handed
+        # out — contents are still valid at that point, so the tier can
+        # copy them host-side; returning True keeps the key answerable
+        # from the host tier instead of forgotten
+        self._evict_hook = None
 
     # -- capacity ----------------------------------------------------------
     @property
@@ -255,6 +275,19 @@ class PageAllocator:
     def pages_in_use(self) -> int:
         """Live pages (refcount >= 1)."""
         return self.num_pages - self.available
+
+    @property
+    def free_pages(self) -> int:
+        """Pages on the free list proper (contents meaningless) — the
+        spill pump's cushion signal: when this runs low, the next alloc
+        starts evicting reclaimable prefix pages synchronously."""
+        return len(self._free)
+
+    @property
+    def reclaimable_pages(self) -> int:
+        """Refcount-0 pages still answering prefix hits — the spill
+        pump's candidate pool."""
+        return len(self._reclaim)
 
     # -- alloc / refcount --------------------------------------------------
     def alloc(self, n: int) -> List[int]:
@@ -274,10 +307,24 @@ class PageAllocator:
                 page = self._free.pop()
             else:  # evict the least-recently-used cached prefix page
                 page, _ = self._reclaim.popitem(last=False)
-                del self._prefix[self._page_key.pop(page)]
+                key = self._page_key.pop(page)
+                del self._prefix[key]
+                # demote instead of forget when a host tier is attached:
+                # the hook copies the page's bytes out NOW (they stay
+                # valid until the new owner's first write) and the key
+                # keeps answering prefix hits from the host tier
+                if self._evict_hook is not None and self._evict_hook(
+                    key, page
+                ):
+                    self._host[key] = None
             self._rc[page] = 1
             out.append(page)
         return out
+
+    def set_evict_hook(self, hook) -> None:
+        """Install the alloc-pressure demotion hook (see ``__init__``);
+        None detaches it (evictions forget contents again)."""
+        self._evict_hook = hook
 
     def incref(self, page: int) -> None:
         rc = self._rc.get(page, 0)
@@ -336,17 +383,101 @@ class PageAllocator:
 
     def clear_prefix(self) -> None:
         """Drop every prefix entry; reclaimable pages return to the free
-        list (benchmark hygiene: warmup must not seed the timed run)."""
+        list (benchmark hygiene: warmup must not seed the timed run).
+        Host-tier keys are forgotten too — the caller owns releasing the
+        matching host-pool slots (:meth:`HostPageTier.clear`)."""
         for page in list(self._reclaim):
             del self._prefix[self._page_key.pop(page)]
             self._free.append(page)
         self._reclaim.clear()
         for page in list(self._page_key):  # live pages: unregister only
             del self._prefix[self._page_key.pop(page)]
+        self._host.clear()
 
     @property
     def prefix_entries(self) -> int:
         return len(self._prefix)
+
+    # -- host tier ---------------------------------------------------------
+    def tier_state(self, key) -> Optional[str]:
+        """Where ``key``'s chunk currently lives: ``"resident"`` (an HBM
+        page, live or reclaimable), ``"host"`` (host pool only), or None
+        (not cached anywhere — prefill must recompute it)."""
+        if key in self._prefix:
+            return "resident"
+        if key in self._host:
+            return "host"
+        return None
+
+    def spill_prefix(self, key) -> int:
+        """Demote a RECLAIMABLE prefix page to the host tier: its HBM
+        page returns to the free list and the key is answered from host
+        from now on.  Returns the freed page id.  The caller must have
+        already copied the page's leaves device→host — after this call
+        the page id may be reallocated and overwritten at any time.
+
+        Only refcount-0 pages spill: a live page is mapped by a block
+        table some decode step may read this iteration, so spilling it
+        would corrupt an active stream (the never-spill-a-decode-active
+        -page rule)."""
+        page = self._prefix.get(key)
+        if page is None:
+            raise ValueError(f"spill of unregistered prefix key {key!r}")
+        if page not in self._reclaim:
+            raise ValueError(
+                f"page {page} is live (rc={self._rc.get(page, 0)}); "
+                "only reclaimable pages may spill"
+            )
+        del self._reclaim[page]
+        del self._prefix[key]
+        del self._page_key[page]
+        self._free.append(page)
+        self._host[key] = None
+        return page
+
+    def host_prefix(self, key) -> None:
+        """Record ``key`` as host-resident WITHOUT it ever having been in
+        the prefix table — the preemption path uses this to spill a
+        victim's private full pages (copied device→host by the caller)
+        so the retry's prefix walk restores them instead of
+        re-prefilling."""
+        if key in self._prefix:
+            raise ValueError(f"key {key!r} already resident")
+        self._host[key] = None
+
+    def restore_prefix(self, key, page: int) -> None:
+        """Promote a host-tier key back to resident: ``page`` is a
+        freshly allocated (live) page the caller has already filled with
+        the key's host-pool bytes.  The key leaves the host set and the
+        prefix table answers it as resident again."""
+        if key not in self._host:
+            raise ValueError(f"restore of non-host key {key!r}")
+        if self._rc.get(page, 0) < 1:
+            raise ValueError(f"cannot restore into non-live page {page}")
+        del self._host[key]
+        self.register_prefix(key, page)
+
+    def drop_host(self, key) -> None:
+        """Forget a host-tier key (host-pool LRU eviction dropped its
+        bytes) — the next miss on it re-prefills from scratch."""
+        del self._host[key]
+
+    def coldest_reclaimable(self, n: int) -> List[tuple]:
+        """Up to ``n`` LRU-first ``(key, page)`` spill candidates: pages
+        with refcount 0 still named by the prefix table — exactly the
+        set whose bytes are stable (no decode lane can write them) and
+        whose HBM a hotter sequence could use.  The spill pump walks
+        this list; live pages never appear in it."""
+        out: List[tuple] = []
+        for page in self._reclaim:
+            if len(out) >= n:
+                break
+            out.append((self._page_key[page], page))
+        return out
+
+    @property
+    def host_entries(self) -> int:
+        return len(self._host)
 
     # -- invariants (test hook) -------------------------------------------
     def check(self) -> None:
@@ -365,6 +496,21 @@ class PageAllocator:
         assert reclaim <= set(self._page_key), "reclaimable page unnamed"
         for key, page in self._prefix.items():
             assert self._page_key.get(page) == key, "prefix maps diverged"
+        # a prefix entry must name a page that still HOLDS its bytes: a
+        # freed page may be reallocated and overwritten at any moment,
+        # so a table entry pointing at one is a stale-read time bomb
+        # (this is exactly the corruption a buggy spill path produces —
+        # freeing the page without unregistering the key)
+        prefix_pages = set(self._page_key)
+        assert not (prefix_pages & free), \
+            "prefix entry names a freed page"
+        assert prefix_pages <= live | reclaim, \
+            "prefix entry names an untracked page"
+        # host-tier keys are keys WITHOUT an HBM page: a key answered in
+        # both tiers would let restore and resident reads race
+        host_keys = set(self._host)
+        assert not (host_keys & set(self._prefix)), \
+            "prefix key both resident and host"
 
 
 def insert_pages(

@@ -1,0 +1,244 @@
+"""Host-memory page tier beneath the paged KV cache.
+
+The paged pool (``serve/kv_cache.py``) rations HBM by pages, and until
+this module a cold prefix page had exactly two fates: stay resident
+(burning HBM a hot sequence wants) or be evicted-and-forgotten (so the
+next session over that prefix pays a full re-prefill).  At the ROADMAP's
+millions-of-mostly-idle-conversations scale both are wrong: prefix pages
+are too valuable to drop and too cold to deserve HBM.  This tier gives
+them a third place to live — a **pinned host pool sized in pages**:
+
+- **spill** (:meth:`HostPageTier.spill_in`) copies a page's leaves
+  device→host: the ``{k, v}`` value leaves *and*, on the int8 layout,
+  their f32 scale leaves — the copy moves exactly the pool bytes, so a
+  quantized page transfers ~4× cheaper than f32.  The D2H readback is
+  the tier's ONE designed host sync, budgeted in the hot-region lint
+  registry (``kv-tier-spill``);
+- **prefetch** (:meth:`HostPageTier.dispatch_restore`) stages the page
+  back host→device via ``jax.device_put`` — an ASYNC dispatch, so the
+  engine commits the page into the pool and decode keeps running while
+  the DMA is in flight; :meth:`poll` retires landed transfers and
+  :meth:`drain` is the blocking fence the scheduler's admission gate
+  uses before it would otherwise preempt;
+- **restore is bit-identical by construction**: spill and restore move
+  raw leaf bytes — no requantize, no recompute — so a decode over a
+  spilled-then-restored page equals the never-spilled run exactly, on
+  both the f32 and int8 layouts (``tests/test_kv_tier.py`` pins it).
+
+The tier owns only host memory and the key→slot map; the
+:class:`~.kv_cache.PageAllocator` owns which prefix keys are
+``resident`` / ``host`` / gone (its ``tier_state``), and the engine owns
+the device-side commit.  Slot lifecycle: a spilled key holds a host slot
+until it is restored (the slot is freed once the H2D transfer LANDS —
+freeing it at dispatch would let a new spill overwrite bytes an async
+DMA may still be reading) or until LRU pressure in the host pool drops
+it (the caller un-registers the key so the next miss re-prefills).
+
+Host bytes are real memory and must not be invisible: the engine
+registers the pool under the ``kv_host_pages`` ledger owner
+(``obs/ledger.py``) — attributed in every snapshot and fleet watermark,
+but excluded from the HBM admission forecast (host RAM is not HBM).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+__all__ = ["HostPageTier", "TIER_POLICIES"]
+
+#: host-pool replacement policies: ``lru`` touches a key on every spill
+#: hit so long-lived prefixes survive churn; ``fifo`` drops in strict
+#: spill order (cheaper bookkeeping, predictable for tests)
+TIER_POLICIES = ("lru", "fifo")
+
+
+class HostPageTier:
+    """Pinned host pool of KV pages + the in-flight prefetch ledger.
+
+    ``cache`` supplies the leaf layout (names, page dims, dtypes); the
+    pool preallocates ``host_pages`` page-rows per leaf up front — one
+    contiguous block per leaf, sized once, so steady-state serving never
+    allocates host memory (the "pinned" contract: on TPU these are the
+    staging buffers the DMA engine reads, and growing them mid-decode
+    would stall the very transfers they exist to hide).
+    """
+
+    def __init__(self, cache, host_pages: int, *, policy: str = "lru"):
+        if host_pages < 1:
+            raise ValueError(f"host_pages must be >= 1, got {host_pages}")
+        if policy not in TIER_POLICIES:
+            raise ValueError(
+                f"unknown tier policy {policy!r}; pick from {TIER_POLICIES}"
+            )
+        self.host_pages = host_pages
+        self.policy = policy
+        # one host mirror per pool leaf, page dims preserved: k/v values
+        # AND the int8 layout's scale leaves — spilling values without
+        # scales would make the restore decode garbage
+        self._pool: Dict[str, np.ndarray] = {
+            name: np.zeros(
+                (host_pages,) + tuple(leaf.shape[1:]),
+                np.dtype(leaf.dtype),
+            )
+            for name, leaf in cache.items()
+        }
+        self._free: List[int] = list(range(host_pages - 1, -1, -1))
+        # key -> host slot, LRU-ordered (oldest first)
+        self._slots: "OrderedDict[Any, int]" = OrderedDict()
+        # key -> (slot, dispatched device leaves): slots held until the
+        # H2D transfer lands (see module docstring)
+        self._inflight: Dict[Any, Tuple[int, List[jax.Array]]] = {}
+        # run counters (ServeReport / FleetReport surface these)
+        self.spilled_pages = 0
+        self.restored_pages = 0
+        self.dropped_pages = 0
+        self.host_pages_peak = 0
+
+    # -- accounting --------------------------------------------------------
+    @property
+    def page_host_bytes(self) -> int:
+        """Host bytes of ONE page across every leaf (the tier's granule;
+        for the int8 layout ~4× smaller than an f32 page — the cheap-
+        transfer dividend the spec calls out)."""
+        return sum(
+            arr.size // arr.shape[0] * arr.dtype.itemsize
+            for arr in self._pool.values()
+        )
+
+    @property
+    def used_pages(self) -> int:
+        """Host slots holding live bytes (resident + restore-in-flight)."""
+        return len(self._slots) + len(self._inflight)
+
+    @property
+    def inflight(self) -> int:
+        return len(self._inflight)
+
+    def capacity_bytes(self) -> int:
+        return self.host_pages * self.page_host_bytes
+
+    def used_bytes(self) -> int:
+        """Host bytes currently committed to spilled pages — what the
+        ``kv_host_pages`` ledger owner attributes."""
+        return self.used_pages * self.page_host_bytes
+
+    def has(self, key) -> bool:
+        return key in self._slots
+
+    # -- spill (device -> host) -------------------------------------------
+    def spill_in(self, cache, key, page: int) -> Optional[List[Any]]:
+        """Copy ``page``'s leaves from the device pool into a host slot
+        under ``key``.  Returns the list of host-LRU-evicted keys the
+        caller must un-register (``PageAllocator.drop_host``), or None
+        when the pool cannot take the page right now (every slot pinned
+        by an in-flight restore) — the caller skips the spill; nothing
+        was copied or evicted.
+
+        The caller guarantees the page's bytes are STABLE for the copy:
+        reclaimable (refcount 0) for pump spills, or a preempted slot's
+        private page after its last decode step — never a page an active
+        decode lane may write this iteration."""
+        if key in self._slots:  # already host-resident: bytes identical
+            return []
+        evicted: List[Any] = []
+        if not self._free:
+            if not self._slots:
+                return None  # every slot pinned by an in-flight restore
+            old_key, old_slot = self._slots.popitem(last=False)
+            self._free.append(old_slot)
+            self.dropped_pages += 1
+            evicted.append(old_key)
+        slot = self._free.pop()
+        for name, host in self._pool.items():
+            host[slot] = np.asarray(cache[name][page])  # sync-ok: D2H page spill — the tier's one designed readback
+        self._slots[key] = slot
+        self.spilled_pages += 1
+        self.host_pages_peak = max(self.host_pages_peak, self.used_pages)
+        return evicted
+
+    # -- prefetch (host -> device) ----------------------------------------
+    def dispatch_restore(self, key) -> Dict[str, jax.Array]:
+        """Start the ASYNC host→device transfer of ``key``'s page and
+        return the per-leaf device arrays for the engine to commit into
+        the pool (``cache[leaf].at[page].set(...)``).  The host slot
+        stays pinned in the in-flight ledger until :meth:`poll` or
+        :meth:`drain` observes the transfer landed — the DMA may still
+        be reading those host bytes."""
+        slot = self._slots.pop(key)
+        dev = {
+            name: jax.device_put(host[slot])
+            for name, host in self._pool.items()
+        }
+        self._inflight[key] = (slot, list(dev.values()))
+        self.restored_pages += 1
+        return dev
+
+    def poll(self) -> int:
+        """Retire landed prefetches (freeing their host slots); returns
+        how many transfers are STILL in flight — the scheduler's
+        admission gate reads this as "restorable pages are arriving,
+        don't preempt yet"."""
+        landed = [
+            key
+            for key, (_, arrs) in self._inflight.items()
+            if all(a.is_ready() for a in arrs)
+        ]
+        for key in landed:
+            slot, _ = self._inflight.pop(key)
+            self._free.append(slot)
+        return len(self._inflight)
+
+    def drain(self) -> None:
+        """Block until every in-flight prefetch lands (the admission
+        gate's fence: ``jax.block_until_ready`` is a device fence, not a
+        host readback — no bytes come back, so it is not a lint sync)."""
+        for _, arrs in self._inflight.values():
+            jax.block_until_ready(arrs)
+        self.poll()
+
+    # -- lifecycle ---------------------------------------------------------
+    def touch(self, key) -> None:
+        """LRU-touch ``key`` (a lookup found it hot); fifo policy keeps
+        strict spill order."""
+        if self.policy == "lru" and key in self._slots:
+            self._slots.move_to_end(key)
+
+    def drop(self, key) -> None:
+        """Free ``key``'s host slot (caller-side eviction)."""
+        slot = self._slots.pop(key)
+        self._free.append(slot)
+        self.dropped_pages += 1
+
+    def clear(self) -> None:
+        """Release every slot (benchmark hygiene, paired with the
+        allocator's ``clear_prefix``).  Drains in-flight restores first —
+        freeing a slot under an active DMA is the exact bug the
+        in-flight ledger exists to prevent."""
+        self.drain()
+        for key in list(self._slots):
+            self.drop(key)
+
+    def reset_stats(self) -> None:
+        """Zero the run counters (benchmark warmup hygiene); resident
+        slots and in-flight restores are untouched."""
+        self.spilled_pages = 0
+        self.restored_pages = 0
+        self.dropped_pages = 0
+        self.host_pages_peak = 0
+
+    def check(self) -> None:
+        """Tier invariants (tests call this after mutation patterns):
+        slots partition exactly into free / resident / in-flight."""
+        resident = set(self._slots.values())
+        free = set(self._free)
+        pinned = {slot for slot, _ in self._inflight.values()}
+        assert len(free) == len(self._free), "duplicate free host slot"
+        assert not (resident & free), "host slot both resident and free"
+        assert not (resident & pinned), "host slot both resident and pinned"
+        assert not (free & pinned), "host slot both free and pinned"
+        assert resident | free | pinned == set(range(self.host_pages)), \
+            "host slot leaked"

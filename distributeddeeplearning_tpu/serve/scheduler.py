@@ -276,6 +276,24 @@ class ServeReport:
     # — plus the lossless-preemption event count
     per_class: Dict[str, Any] = dataclasses.field(default_factory=dict)
     preemptions: int = 0
+    # KV host page tier (PR 19, serve/kv_tier.py): spill/restore volume,
+    # the host-tier share of prefix hits, and the host-pool watermark.
+    # Zero-filled when no tier is attached, so artifact schemas stay
+    # uniform across tiered and untiered runs.
+    tier_enabled: bool = False
+    tier_host_pages: int = 0
+    tier_spilled_pages: int = 0
+    tier_restored_pages: int = 0
+    tier_dropped_pages: int = 0
+    tier_host_pages_peak: int = 0
+    tier_host_bytes_peak: int = 0
+    # prompt tokens answered by a host-tier RESTORE (subset of the
+    # prefix_hit_rate numerator): re-prefill compute the tier turned
+    # into DMA
+    tier_prefix_hit_tokens_host: int = 0
+    # private pages demoted by the preemption path (victims resume
+    # without re-prefilling their generated history)
+    tier_preempt_spilled_pages: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -561,6 +579,42 @@ class ContinuousBatchingScheduler:
                 victim, victim_key = slot, key
         return victim
 
+    def _tier_pump(self, engine, hbm_ledger) -> int:
+        """One spill/prefetch pump pass per scheduler iteration.
+
+        Retires landed host→HBM prefetches (freeing their pinned host
+        slots), then — when the HBM forecast or the free-page count says
+        pressure is near — demotes the coldest reclaimable prefix pages
+        to the host tier ahead of demand, so allocation under load finds
+        free pages instead of triggering the designed D2H copy
+        synchronously inside ``alloc``'s evict hook.  Returns how many
+        pages were spilled this pass (capped: the pump must stay a
+        bounded slice of the iteration, not a stop-the-world sweep).
+
+        Registered hot region (analysis/regions.py, sync budget 0): the
+        spill itself is the budgeted sync inside
+        ``HostPageTier.spill_in`` — THIS method only reads host-side
+        counters and the ledger forecast and must never grow a readback
+        of its own.
+        """
+        engine.tier_inflight()  # retire landed prefetches
+        target = max(1, engine.num_pages // 8)  # free-page cushion
+        pressure = engine.allocator.free_pages < target
+        if (
+            not pressure
+            and hbm_ledger is not None
+            and hbm_ledger.capacity_bytes is not None
+        ):
+            forecast = hbm_ledger.forecast(0)
+            pressure = (
+                forecast["headroom_bytes"]
+                < target * engine.page_bytes_each
+            )
+        if not pressure:
+            return 0
+        want = min(8, max(1, target - engine.allocator.free_pages))
+        return engine.spill_cold_pages(want)
+
     def run(
         self,
         requests: Iterable[Request],
@@ -619,6 +673,14 @@ class ContinuousBatchingScheduler:
         admit_bytes = getattr(engine, "admit_bytes", None)
         if admit_bytes is None:
             hbm_ledger = None
+        # KV host page tier (serve/kv_tier.py), resolved once: the pump
+        # and the preemption spill are no-ops for engines without one
+        tier = getattr(engine, "tier", None)
+        spill_slot_pages = (
+            getattr(engine, "spill_slot_pages", None)
+            if tier is not None else None
+        )
+        tier_preempt_spilled = 0
         t_start = time.perf_counter()
 
         active: Dict[int, _SlotState] = {}
@@ -1061,8 +1123,14 @@ class ContinuousBatchingScheduler:
             for quarantine, not policy).  Budget spent: the victim
             finishes terminal "preempted" with NO tokens — graceful
             starvation; every cut either frees capacity for the head or
-            retires the victim, so the loop can never livelock."""
-            nonlocal preempted_events
+            retires the victim, so the loop can never livelock.
+
+            With a host tier attached the victim's PRIVATE full pages
+            are spilled host-side before release (instead of dissolving
+            into the free list) — the retry's prefix walk restores them
+            by DMA, so a preempted best-effort stream resumes without
+            re-prefilling its generated history."""
+            nonlocal preempted_events, tier_preempt_spilled
             m = meta[st.req.uid]
             if m.preemptions >= self.preempt_budget:
                 del active[slot]
@@ -1084,15 +1152,23 @@ class ContinuousBatchingScheduler:
                 m.ttft_s = st.ttft_s
                 m.queue_wait_s = st.queue_wait_s
             m.preserved = m.preserved + list(st.generated)
+            resume_tokens = list(st.req.prompt) + list(st.generated)
             retry = Request(
                 uid=st.req.uid,
-                prompt=list(st.req.prompt) + list(st.generated),
+                prompt=resume_tokens,
                 max_new_tokens=st.budget - len(st.generated),
                 trace_id=st.req.trace_id,
                 tenant=st.req.tenant,
                 priority=st.req.priority,
             )
             del active[slot]
+            # spill the victim's private full pages BEFORE release: the
+            # copies need the pages still mapped; after release their
+            # ids are free and the next alloc may overwrite them
+            if spill_slot_pages is not None:
+                tier_preempt_spilled += spill_slot_pages(
+                    slot, resume_tokens
+                )
             release(slot)
             free.append(slot)
             pending.appendleft(retry)
@@ -1296,6 +1372,14 @@ class ContinuousBatchingScheduler:
                         if victim is not None:
                             preempt_slot(victim, active[victim])
 
+                # spill/prefetch pump: one pass per iteration retires
+                # landed prefetches and keeps a free-page cushion by
+                # demoting the coldest reclaimable prefix pages — the
+                # designed D2H copy runs HERE, off the admission path,
+                # instead of synchronously inside alloc's evict hook
+                if tier is not None:
+                    self._tier_pump(engine, hbm_ledger)
+
                 hbm_committed = None  # ledger walk amortized per iteration
                 while (
                     pending and not draining and free
@@ -1331,7 +1415,15 @@ class ContinuousBatchingScheduler:
                             ))
                             continue
                         if not engine.can_admit(len(req.prompt), budget):
-                            # PAGE pressure: cut a strictly-lower-class
+                            # PAGE pressure: with restores in flight the
+                            # page accounting is mid-transition — fence
+                            # them (admit gates until the prefetch
+                            # LANDS) before cutting a victim against a
+                            # transient reading
+                            if tier is not None and engine.tier_inflight():
+                                engine.drain_tier()
+                                continue
+                            # cut a strictly-lower-class
                             # decode (its pages release) and re-check;
                             # no victim -> shed the head if it is
                             # lowest-class and the policy allows
@@ -1384,9 +1476,19 @@ class ContinuousBatchingScheduler:
                                 extra, committed=hbm_committed
                             ):
                                 # HBM-forecast pressure: same ladder as
-                                # page pressure — preempt strictly lower,
-                                # then shed a lowest-class head, then
-                                # block on in-flight completions
+                                # page pressure — fence in-flight
+                                # prefetches first (landing frees host
+                                # slots and settles the forecast), then
+                                # preempt strictly lower, then shed a
+                                # lowest-class head, then block on
+                                # in-flight completions
+                                if (
+                                    tier is not None
+                                    and engine.tier_inflight()
+                                ):
+                                    engine.drain_tier()
+                                    hbm_committed = None
+                                    continue
                                 victim = self._preemption_victim(
                                     active, self._class_rank[req.priority]
                                 )
@@ -1776,6 +1878,29 @@ class ContinuousBatchingScheduler:
                 for cls, cs in sorted(class_stats.items())
             },
             preemptions=preempted_events,
+            tier_enabled=tier is not None,
+            tier_host_pages=tier.host_pages if tier is not None else 0,
+            tier_spilled_pages=(
+                tier.spilled_pages if tier is not None else 0
+            ),
+            tier_restored_pages=(
+                tier.restored_pages if tier is not None else 0
+            ),
+            tier_dropped_pages=(
+                tier.dropped_pages if tier is not None else 0
+            ),
+            tier_host_pages_peak=(
+                tier.host_pages_peak if tier is not None else 0
+            ),
+            tier_host_bytes_peak=(
+                tier.host_pages_peak * tier.page_host_bytes
+                if tier is not None else 0
+            ),
+            tier_prefix_hit_tokens_host=(
+                getattr(engine, "prefix_hit_tokens_host", 0)
+                if tier is not None else 0
+            ),
+            tier_preempt_spilled_pages=tier_preempt_spilled,
         )
         # end-of-run rollup into the process metrics registry (one
         # record_many per stream, NOT per step — the hot loop stays hot):
@@ -1802,6 +1927,19 @@ class ContinuousBatchingScheduler:
         reg.gauge("serve.slot_occupancy_mean").set(
             report.slot_occupancy_mean
         )
+        if tier is not None:
+            # host-tier health: fleet workers export these per replica,
+            # so FleetReport watermarks show which replica is thrashing
+            # its host pool (high drop rate = pool too small for the
+            # prefix working set)
+            reg.counter("serve.tier.spilled_pages").inc(tier.spilled_pages)
+            reg.counter("serve.tier.restored_pages").inc(
+                tier.restored_pages
+            )
+            reg.counter("serve.tier.dropped_pages").inc(tier.dropped_pages)
+            reg.gauge("serve.tier.host_pages_peak").set(
+                tier.host_pages_peak
+            )
         if spec is not None:
             # the drafter-health gauge obs dashboards watch: an
             # acceptance-rate collapse is a throughput regression with

@@ -1,0 +1,505 @@
+"""Host-memory KV page tier (serve/kv_tier.py + allocator tier states).
+
+The load-bearing guarantee: spilling a page to host and restoring it
+must be invisible to decode — a greedy stream over a spilled-then-
+restored prefix page equals the never-spilled run EXACTLY, on the f32
+and int8 page layouts and against the dense-layout oracle.  Around that
+sit the lifecycle rules the tier's correctness depends on: a live
+(decode-active) page can never spill, a freed page can never stay named
+by the prefix table (the seeded-violation test), an in-flight prefetch
+pins its host slot and gates admission until it lands, and a preempted
+stream's private pages spill instead of vanishing so the resume skips
+re-prefill.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.models.pipelined_transformer import (
+    forward,
+    init_params,
+)
+from distributeddeeplearning_tpu.obs.ledger import HBMLedger
+from distributeddeeplearning_tpu.serve import (
+    ContinuousBatchingScheduler,
+    HostPageTier,
+    InferenceEngine,
+    OutOfPages,
+    PagedInferenceEngine,
+    Request,
+    init_paged_cache,
+)
+
+CFG = dict(num_layers=3, d_model=32, num_heads=4, d_ff=64, vocab_size=61,
+           max_len=64)
+HEADS = CFG["num_heads"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.key(0), **CFG)
+
+
+def _naive_greedy(params, prompt, n):
+    toks = list(prompt)
+    for _ in range(n):
+        logits = forward(params, jnp.asarray([toks], jnp.int32),
+                         num_heads=HEADS)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def _engine(params, *, host_pages=0, cache_dtype=None, num_pages=24,
+            batch_slots=2, page_size=4, prefill_chunk=8, max_seq=48,
+            **kw):
+    return PagedInferenceEngine(
+        params, num_heads=HEADS, batch_slots=batch_slots, max_seq=max_seq,
+        page_size=page_size, num_pages=num_pages,
+        prefill_chunk=prefill_chunk, cache_dtype=cache_dtype,
+        host_pages=host_pages, **kw)
+
+
+def _run(engine, requests, n=6):
+    results, report = ContinuousBatchingScheduler(
+        engine, max_new_tokens=n).run(requests)
+    return {r.uid: list(r.tokens) for r in results}, report
+
+
+# --------------------------------------------------------------------------
+# bit-identical spill/restore round trips
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("cache_dtype", [None, jnp.int8],
+                         ids=["f32", "int8"])
+def test_spill_restore_bit_identical(params, cache_dtype):
+    """Greedy decode over spilled-then-restored prefix pages equals the
+    never-spilled run, f32 and int8 layouts, with prompt lengths ending
+    mid-page AND mid-chunk (page_size 4, prefill_chunk 8: lengths 9, 13
+    and 17 exercise every offset class the restore path can meet)."""
+    rng = np.random.default_rng(0)
+    base = rng.integers(1, CFG["vocab_size"], 8).tolist()
+    reqs = [
+        Request(uid=f"r{n}",
+                prompt=base + rng.integers(1, CFG["vocab_size"],
+                                           n - 8).tolist())
+        for n in (9, 13, 17)
+    ]
+
+    never_eng = _engine(params, cache_dtype=cache_dtype)
+    never, _ = _run(never_eng, reqs)
+
+    eng = _engine(params, cache_dtype=cache_dtype, host_pages=16)
+    seeded, _ = _run(eng, reqs)
+    assert seeded == never
+    spilled = eng.spill_cold_pages(10**6)
+    assert spilled > 0, "nothing reclaimable spilled — the test is inert"
+    assert eng.allocator.host_entries == spilled
+    restored_run, rep = _run(eng, reqs)
+    assert restored_run == never, (
+        "decode over spilled-then-restored pages diverged from the "
+        "never-spilled run"
+    )
+    assert eng.tier.restored_pages > 0
+    assert rep.tier_enabled and rep.tier_restored_pages > 0
+    assert eng.prefix_hit_tokens_host > 0
+    eng.allocator.check()
+    eng.tier.check()
+    # the f32 run also matches the dense-layout oracle end to end
+    if cache_dtype is None:
+        for r in reqs:
+            assert restored_run[r.uid] == _naive_greedy(
+                params, list(r.prompt), 6)
+
+
+def test_spill_restore_bit_identical_dense_cross_check(params):
+    """The dense layout runs the same greedy traffic: the paged engine's
+    spilled-then-restored tokens equal the dense engine's (both layouts
+    see the identical stream — the tier is invisible across layouts)."""
+    rng = np.random.default_rng(3)
+    reqs = [
+        Request(uid=f"d{i}",
+                prompt=rng.integers(1, CFG["vocab_size"], 11).tolist())
+        for i in range(3)
+    ]
+    dense = InferenceEngine(params, num_heads=HEADS, batch_slots=2,
+                            max_seq=48, prefill_attention="dense")
+    dense_toks, _ = _run(dense, reqs)
+
+    eng = _engine(params, host_pages=16)
+    _run(eng, reqs)
+    assert eng.spill_cold_pages(10**6) > 0
+    restored_run, _ = _run(eng, reqs)
+    assert restored_run == dense_toks
+
+
+# --------------------------------------------------------------------------
+# lifecycle rules
+# --------------------------------------------------------------------------
+
+def test_never_spill_a_decode_active_page(params):
+    """spill_prefix refuses a page a live sequence still references —
+    spilling under an active decode would corrupt the stream."""
+    eng = _engine(params, host_pages=8)
+    task = eng.prefill_begin(0, list(range(1, 10)), 4)
+    while not task.done:
+        eng.prefill_step(task)
+    # the slot holds refs on its prompt pages: every registered prefix
+    # key is LIVE, so nothing is cold enough to spill
+    assert eng.spill_cold_pages(10**6) == 0
+    live_keys = list(eng.allocator._prefix)
+    assert live_keys, "prefill registered no prefix pages"
+    with pytest.raises(ValueError, match="live"):
+        eng.allocator.spill_prefix(live_keys[0])
+    eng.release(0)
+    # released -> reclaimable -> now spillable
+    assert eng.spill_cold_pages(10**6) > 0
+    eng.allocator.check()
+    eng.tier.check()
+
+
+def test_out_of_pages_spill_admit_recovery(params):
+    """OutOfPages -> spill cold pages -> the same admission succeeds:
+    the tier turns page exhaustion into host demotion, not failure."""
+    eng = _engine(params, host_pages=16, num_pages=7, batch_slots=2)
+    # fill the pool with a completed request's pages (reclaimable prefix
+    # entries + free remainder), then occupy the rest
+    task = eng.prefill_begin(0, list(range(1, 14)), 4)
+    while not task.done:
+        eng.prefill_step(task)
+    eng.release(0)
+    reclaim_before = eng.allocator.reclaimable_pages
+    assert reclaim_before > 0
+    spilled = eng.spill_cold_pages(10**6)
+    assert spilled == reclaim_before
+    assert eng.allocator.free_pages >= spilled
+    # admission that needs the freed pages now succeeds, and the walk
+    # restores the spilled prefix from host instead of re-prefilling
+    task = eng.prefill_begin(1, list(range(1, 14)), 4)
+    assert eng.prefix_hit_tokens_host > 0
+    while not task.done:
+        eng.prefill_step(task)
+    eng.release(1)
+    eng.allocator.check()
+    eng.tier.check()
+
+
+def test_prefetch_inflight_pins_slot_and_drains(params):
+    """A dispatched restore holds its host slot in the in-flight ledger
+    (the async DMA may still read those bytes); poll/drain retire it.
+    The scheduler-facing accessors mirror the same state."""
+    eng = _engine(params, host_pages=4)
+    task = eng.prefill_begin(0, list(range(1, 10)), 4)
+    while not task.done:
+        eng.prefill_step(task)
+    eng.release(0)
+    assert eng.spill_cold_pages(10**6) > 0
+    tier = eng.tier
+    key = next(iter(eng.allocator._host))
+    used_before = tier.used_pages
+    dev = tier.dispatch_restore(key)
+    assert tier.inflight == 1
+    assert tier.used_pages == used_before  # slot still pinned
+    tier.check()
+    jax.block_until_ready(list(dev.values()))
+    assert tier.poll() == 0
+    assert tier.inflight == 0
+    assert tier.used_pages == used_before - 1
+    tier.check()
+    # engine accessors: nothing in flight now, drain is a no-op
+    assert eng.tier_inflight() == 0
+    eng.drain_tier()
+
+
+def test_host_pool_lru_eviction_and_policy():
+    """A full host pool evicts its LRU slot to take a new spill; fifo
+    keeps strict spill order (no touch promotion)."""
+    cache = init_paged_cache(num_pages=8, num_layers=1, page_size=2,
+                             num_heads=1, head_dim=4)
+    tier = HostPageTier(cache, 2, policy="lru")
+    assert tier.spill_in(cache, "a", 1) == []
+    assert tier.spill_in(cache, "b", 2) == []
+    tier.touch("a")                       # "a" becomes MRU
+    assert tier.spill_in(cache, "c", 3) == ["b"]
+    assert tier.has("a") and tier.has("c") and not tier.has("b")
+    assert tier.dropped_pages == 1
+    tier.check()
+
+    fifo = HostPageTier(cache, 2, policy="fifo")
+    fifo.spill_in(cache, "a", 1)
+    fifo.spill_in(cache, "b", 2)
+    fifo.touch("a")                       # fifo ignores the touch
+    assert fifo.spill_in(cache, "c", 3) == ["a"]
+    fifo.check()
+
+    with pytest.raises(ValueError, match="policy"):
+        HostPageTier(cache, 2, policy="mru")
+    with pytest.raises(ValueError, match="host_pages"):
+        HostPageTier(cache, 0)
+
+
+# --------------------------------------------------------------------------
+# allocator invariants: the seeded-violation bugfix test
+# --------------------------------------------------------------------------
+
+def test_check_catches_prefix_entry_naming_a_freed_page():
+    """The PR's bugfix: check() must detect a prefix-table entry whose
+    page index sits on the free list (a use-after-free the old
+    invariants never looked for) and a key resident in both tiers."""
+    from distributeddeeplearning_tpu.serve import PageAllocator
+
+    alloc = PageAllocator(8)
+    (page,) = alloc.alloc(1)
+    alloc.register_prefix(("k",), page)
+    alloc.check()                        # healthy: live + registered
+    alloc.decref(page)                   # -> reclaimable (rc 0)
+    alloc.check()
+    # seed the violation: the page leaks onto the free list while the
+    # prefix table still names it — the exact use-after-free shape the
+    # old invariants never looked for (the page is NOT live, so the
+    # pre-existing "live and free" check stays silent)
+    del alloc._reclaim[page]
+    alloc._free.append(page)
+    with pytest.raises(AssertionError, match="freed page"):
+        alloc.check()
+    alloc._free.remove(page)
+    alloc._reclaim[page] = None
+    alloc.check()
+    # second seeded violation: one key both resident and host
+    alloc._host[("k",)] = None
+    with pytest.raises(AssertionError, match="resident and host"):
+        alloc.check()
+
+
+def test_tier_state_transitions_and_strictness():
+    from distributeddeeplearning_tpu.serve import PageAllocator
+
+    alloc = PageAllocator(4)
+    (page,) = alloc.alloc(1)
+    alloc.register_prefix(("p",), page)
+    assert alloc.tier_state(("p",)) == "resident"
+    with pytest.raises(ValueError):
+        alloc.spill_prefix(("p",))       # live page: never spillable
+    alloc.decref(page)                   # -> reclaimable
+    assert alloc.spill_prefix(("p",)) == page
+    assert alloc.tier_state(("p",)) == "host"
+    assert alloc.lookup_prefix(("p",)) is None
+    alloc.check()
+    (fresh,) = alloc.alloc(1)
+    alloc.restore_prefix(("p",), fresh)
+    assert alloc.tier_state(("p",)) == "resident"
+    alloc.check()
+    with pytest.raises(KeyError):
+        alloc.drop_host(("p",))          # no longer host-resident
+
+
+# --------------------------------------------------------------------------
+# scheduler: preemption spills instead of zeroing, admission drains
+# --------------------------------------------------------------------------
+
+def _staged_poll(*stages, idle=400):
+    state = {"n": 0}
+    by_pass = dict(stages)
+
+    def poll():
+        state["n"] += 1
+        if state["n"] > idle:
+            return None
+        return by_pass.get(state["n"], [])
+
+    return poll
+
+
+def test_preempted_stream_resumes_from_host_tier(params):
+    """A preempted best_effort stream's private full pages spill to the
+    host tier; the resume's prefix walk restores them (host hits > 0)
+    and the final tokens equal the unpressured run — resume WITHOUT
+    re-prefilling the whole history."""
+    rng = np.random.default_rng(1)
+    be = Request(uid="be", prompt=rng.integers(1, CFG["vocab_size"],
+                                               8).tolist(),
+                 priority="best_effort")
+    prem = Request(uid="prem", prompt=rng.integers(1, CFG["vocab_size"],
+                                                   5).tolist(),
+                   priority="premium")
+
+    clean, _ = ContinuousBatchingScheduler(
+        _engine(params, host_pages=16, batch_slots=2),
+        max_new_tokens=16).run([be, prem])
+    clean_tokens = {r.uid: list(r.tokens) for r in clean}
+
+    eng = _engine(params, host_pages=16, batch_slots=1)
+    sched = ContinuousBatchingScheduler(eng, max_new_tokens=16,
+                                        preempt_budget=2)
+    results, rep = sched.run(
+        [], poll=_staged_poll((1, [be]), (14, [prem])))
+    by_uid = {r.uid: r for r in results}
+    assert by_uid["be"].preemptions >= 1, "the cut never happened"
+    assert rep.tier_preempt_spilled_pages >= 1, (
+        "preemption zeroed the victim's private pages instead of "
+        "spilling them"
+    )
+    assert eng.prefix_hit_tokens_host > 0, (
+        "the resume re-prefilled instead of restoring from host"
+    )
+    assert list(by_uid["be"].tokens) == clean_tokens["be"]
+    assert list(by_uid["prem"].tokens) == clean_tokens["prem"]
+    eng.allocator.check()
+    eng.tier.check()
+
+
+def test_admission_drains_inflight_prefetch_before_preempting(params):
+    """Prefetch racing admission: with a restore in flight and pages
+    tight, the admission ladder fences the prefetch (drain) and
+    re-checks instead of cutting a victim against transient accounting."""
+    eng = _engine(params, host_pages=8, num_pages=7, batch_slots=1)
+    task = eng.prefill_begin(0, list(range(1, 14)), 4)
+    while not task.done:
+        eng.prefill_step(task)
+    eng.release(0)
+    assert eng.spill_cold_pages(10**6) > 0
+    # dispatch a restore by hand and leave it in flight: admission via
+    # the scheduler must drain it and then admit normally
+    key = next(iter(eng.allocator._host))
+    page = eng._prefetch_page(key)
+    assert page is not None
+    results, rep = ContinuousBatchingScheduler(eng, max_new_tokens=4).run(
+        [Request(uid="x", prompt=list(range(1, 14)))])
+    assert results[0].finish_reason == "length"
+    assert eng.tier_inflight() == 0
+    eng.allocator.check()
+    eng.tier.check()
+
+
+def test_tier_disabled_is_inert(params):
+    """host_pages=0: no tier object, no report fields moving — the
+    default path is byte-for-byte the pre-tier engine."""
+    eng = _engine(params)
+    assert eng.tier is None
+    toks, rep = _run(eng, [Request(uid="a", prompt=[1, 2, 3, 4, 5])])
+    assert not rep.tier_enabled
+    assert rep.tier_spilled_pages == 0
+    assert rep.tier_preempt_spilled_pages == 0
+    assert eng.spill_cold_pages(10) == 0
+    assert eng.tier_inflight() == 0
+    eng.drain_tier()
+
+
+# --------------------------------------------------------------------------
+# observability: ledger owner, fleet watermarks
+# --------------------------------------------------------------------------
+
+def test_ledger_attributes_host_bytes_outside_forecast(params):
+    """The kv_host_pages owner attributes host bytes in snapshots and
+    gauges but stays OUT of committed/forecast — host RAM is not HBM,
+    and counting it would starve admission of the headroom spilling
+    just created."""
+    ledger = HBMLedger(capacity_bytes=10**9)
+    eng = _engine(params, host_pages=8)
+    from distributeddeeplearning_tpu.serve.engine import (
+        _register_engine_owners,
+    )
+    _register_engine_owners(eng, ledger=ledger)
+    assert "kv_host_pages" in ledger.host_owners()
+    committed_before = ledger.committed_bytes()
+    _run(eng, [Request(uid="a", prompt=list(range(1, 10)))])
+    spilled = eng.spill_cold_pages(10**6)
+    assert spilled > 0
+    snap = ledger.snapshot()
+    host_bytes = snap["host_owners"]["kv_host_pages"]["bytes"]
+    assert host_bytes == spilled * eng.tier.page_host_bytes
+    assert snap["host_total_bytes"] == host_bytes
+    # spilling moved bytes OFF the device: committed may only shrink
+    assert ledger.committed_bytes() <= committed_before
+    assert ledger.forecast(0)["headroom_bytes"] >= (
+        ledger.capacity_bytes - committed_before
+    )
+    from distributeddeeplearning_tpu.obs.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    ledger.export_gauges(reg)
+    gauges = reg.state()["gauges"]
+    assert gauges["hbm.kv_host_pages.bytes"]["value"] == host_bytes
+    assert gauges["hbm.host_total_bytes"]["value"] == host_bytes
+
+
+def test_fleet_tier_watermarks_lift():
+    """FleetReport's per-replica tier watermarks lift serve.tier.*
+    counters/gauges from shipped registry states, keyed like the HBM
+    watermarks; replicas without tier traffic stay absent."""
+    from distributeddeeplearning_tpu.serve.fleet import _tier_watermarks
+
+    states = [
+        {"replica_id": 0, "pid": 11,
+         "counters": {"serve.tier.spilled_pages": 3, "serve.requests": 9},
+         "gauges": {"serve.tier.host_pages_peak": {"value": 2.0}}},
+        {"replica_id": 1, "pid": 22, "counters": {"serve.requests": 4},
+         "gauges": {}},
+    ]
+    marks = _tier_watermarks(states)
+    assert marks == {
+        "replica0-11": {"serve.tier.spilled_pages": 3,
+                        "serve.tier.host_pages_peak": 2.0},
+    }
+
+
+def test_int8_spill_moves_scale_leaves():
+    """The int8 layout's f32 scale leaves ride every spill: a host pool
+    built over an int8 cache mirrors k/v AND k_scale/v_scale, and one
+    page's host bytes are ~4x smaller than the f32 layout's."""
+    kw = dict(num_pages=8, num_layers=1, page_size=4, num_heads=2,
+              head_dim=8)
+    f32 = init_paged_cache(**kw)
+    int8 = init_paged_cache(dtype=jnp.int8, **kw)
+    t_f32 = HostPageTier(f32, 2)
+    t_int8 = HostPageTier(int8, 2)
+    assert set(t_int8._pool) == set(int8.keys())
+    assert {"k_scale", "v_scale"} <= set(t_int8._pool)
+    # int8 values + f32 scales: ~4x cheaper per page than f32 values
+    assert t_int8.page_host_bytes < t_f32.page_host_bytes / 2
+    t_int8.spill_in(int8, "k0", 1)
+    for name in int8:
+        np.testing.assert_array_equal(
+            t_int8._pool[name][t_int8._slots["k0"]],
+            np.asarray(int8[name][1]),
+        )
+
+
+# --------------------------------------------------------------------------
+# CI smoke: the tier bench end-to-end through bench.py on CPU
+# --------------------------------------------------------------------------
+
+@pytest.mark.timeout(420)
+def test_bench_tier_cpu_smoke(tmp_path):
+    """Fast tier-1 smoke: bench.py --tier --small with the smoke cap —
+    all four gates must hold on CPU (bit-identity and the hit-rate /
+    tokens-per-byte gates are structural; only the timing floor is
+    loosened in smoke mode)."""
+    report = tmp_path / "TIER_smoke.json"
+    proc = subprocess.run(
+        [
+            sys.executable, "bench.py", "--tier", "--small",
+            "--steps-cap", "1", "--report", str(report),
+        ],
+        capture_output=True, text=True, timeout=400,
+        cwd=str(Path(__file__).resolve().parent.parent),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["gates"] == {
+        "bit_identical": True, "prefix_hit_rate": True,
+        "tokens_per_hbm_byte": True, "decode_tokens_per_sec": True,
+    }
+    payload = json.loads(report.read_text())
+    assert payload["oversubscription"] >= 4
+    assert payload["tier_prefix_hit_rate"] > payload[
+        "tier_prefix_hit_rate_no_tier"]
+    from distributeddeeplearning_tpu.obs.schema import validate_tier_payload
+    validate_tier_payload(payload)
